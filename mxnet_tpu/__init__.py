@@ -1,0 +1,39 @@
+"""mxnet_tpu — a TPU-native deep learning framework with the capabilities of
+MXNet 0.9.x (NNVM era), built on JAX/XLA idioms rather than ported from the
+reference's CUDA/C++ engine. See SURVEY.md for the architectural map.
+"""
+from . import base
+from .base import MXNetError, __version__
+from .context import Context, cpu, cpu_pinned, gpu, tpu, current_context, num_devices
+from . import ndarray
+from . import ndarray as nd
+from . import symbol
+from . import symbol as sym
+from .symbol import Variable, Group
+from . import autograd
+from . import random
+from .random import seed
+from . import executor
+from .executor import Executor
+from .attribute import AttrScope
+from .name import NameManager, Prefix
+from . import initializer
+from .initializer import init_registry  # noqa: F401
+from . import optimizer
+from . import metric
+from . import lr_scheduler
+from . import callback
+from . import io
+from . import kvstore
+from . import module as mod
+from . import module
+from . import monitor
+from .monitor import Monitor
+from . import visualization
+from . import visualization as viz
+from . import test_utils
+from . import model
+from .model import FeedForward
+from . import recordio
+from . import rnn
+from . import profiler

@@ -1,0 +1,48 @@
+"""Attribute scoping (reference python/mxnet/attribute.py AttrScope).
+
+``with mx.AttrScope(ctx_group='dev1'):`` tags symbols created inside the
+scope — the reference's model-parallel placement mechanism
+(example/model-parallel-lstm/lstm.py:48-99, SURVEY §2.2). In the TPU build
+ctx_group maps to sharding groups consumed by the parallel layer.
+"""
+from __future__ import annotations
+
+import threading
+
+
+class AttrScope:
+    _current = threading.local()
+
+    def __init__(self, **kwargs):
+        self._old_scope = None
+        for value in kwargs.values():
+            if not isinstance(value, str):
+                raise ValueError("Attributes need to be strings")
+        self._attr = kwargs
+
+    def get(self, attr):
+        if self._attr:
+            ret = self._attr.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return attr if attr else {}
+
+    def __enter__(self):
+        if not hasattr(AttrScope._current, "value"):
+            AttrScope._current.value = AttrScope()
+        self._old_scope = AttrScope._current.value
+        attr = AttrScope._current.value._attr.copy()
+        attr.update(self._attr)
+        self._attr = attr
+        AttrScope._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        AttrScope._current.value = self._old_scope
+
+
+def current() -> AttrScope:
+    if not hasattr(AttrScope._current, "value"):
+        AttrScope._current.value = AttrScope()
+    return AttrScope._current.value

@@ -1,0 +1,288 @@
+"""Imperative autograd.
+
+TPU-native analogue of the reference autograd runtime
+(src/ndarray/autograd.{h,cc}): a thread-local tape records every imperative
+op call (RecordImperativeFCompute, autograd.cc:70-135); ``backward`` replays
+the recorded graph through ``jax.vjp`` — the counterpart of the reference's
+"build a GraphExecutor over the recorded symbol and run Backward"
+(autograd.cc:138-205).
+
+Design notes:
+- jax arrays are immutable, so a tape node can safely hold the exact input
+  values seen at record time; NDArray mutation after recording cannot
+  corrupt the tape (the reference needs engine versioning for this).
+- Replays are compiled: the whole replay+vjp is jitted once per tape
+  *structure* (op sequence + shapes), so steady-state imperative training
+  pays one XLA executable launch per backward — the analogue of the
+  reference's cached-op path (graph_executor.cc:567-679).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError, attrs_key
+from .ops.registry import OpContext, OpDef
+
+_GRAD_REQ = {"null": 0, "write": 1, "add": 3}
+
+
+class _TapeNode:
+    __slots__ = ("op", "attrs", "inputs", "aux", "rng", "is_train", "outputs", "aux_outputs")
+
+    def __init__(self, op, attrs, inputs, aux, rng, is_train, outputs, aux_outputs):
+        self.op = op
+        self.attrs = attrs
+        self.inputs = tuple(inputs)
+        self.aux = tuple(aux)
+        self.rng = rng
+        self.is_train = is_train
+        self.outputs = tuple(outputs)
+        self.aux_outputs = tuple(aux_outputs)
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+        self.tape: List[_TapeNode] = []
+        # keyed by id(NDArray handle) so rebinds of ._data (optimizer steps,
+        # x[:]=) keep the variable attached; values (handle, grad, req)
+        self.marked: Dict[int, Tuple[Any, Any, str]] = {}
+        self.marked_order: List[int] = []
+
+
+_state = _State()
+_bwd_cache: Dict[Any, Any] = {}
+
+
+def is_recording() -> bool:
+    return _state.recording
+
+
+def is_training() -> bool:
+    return _state.training
+
+
+def set_recording(flag: bool) -> bool:
+    old = _state.recording
+    _state.recording = flag
+    return old
+
+
+def set_training(flag: bool) -> bool:
+    old = _state.training
+    _state.training = flag
+    return old
+
+
+class _RecordScope:
+    def __init__(self, recording, train_mode):
+        self._recording = recording
+        self._train = train_mode
+
+    def __enter__(self):
+        self._old_rec = set_recording(self._recording)
+        self._old_train = set_training(self._train)
+        return self
+
+    def __exit__(self, *args):
+        set_recording(self._old_rec)
+        set_training(self._old_train)
+
+
+def record(train_mode: bool = True):
+    """Context manager entering record+train mode (reference
+    python/mxnet/autograd-style API)."""
+    return _RecordScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _RecordScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordScope(_state.recording, True)
+
+
+def predict_mode():
+    return _RecordScope(_state.recording, False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to arrays (reference autograd.cc:54-68
+    MarkVariables / MXAutogradMarkVariables)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, grad, req in zip(variables, gradients, grad_reqs):
+        var._grad = grad
+        key = id(var)
+        if key not in _state.marked:
+            _state.marked_order.append(key)
+        _state.marked[key] = (var, grad, req)
+
+
+def record_op(op: OpDef, attrs: dict, inputs, aux, rng, is_train, outputs, aux_outputs):
+    """Append one imperative call to the tape (reference
+    RecordImperativeFCompute, autograd.cc:70-82)."""
+    _state.tape.append(
+        _TapeNode(op, attrs, inputs, aux, rng, is_train, outputs, aux_outputs)
+    )
+
+
+def _clear_tape():
+    _state.tape = []
+    _state.marked = {}
+    _state.marked_order = []
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. marked variables and write them into
+    the attached grad buffers honouring grad_req write/add/null.
+
+    Mirrors MXAutogradComputeGradient → AutogradRuntime::ComputeGradient
+    (autograd.cc:138-205), except the "executor" is a jitted jax.vjp replay.
+    """
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+
+    tape = _state.tape
+    marked = dict(_state.marked)
+    order = list(_state.marked_order)
+    if not marked:
+        raise MXNetError("no variables marked for gradient (call mark_variables)")
+
+    # --- classify every array slot: marked var / produced by node / constant
+    produced: Dict[int, Tuple[int, int, bool]] = {}  # id -> (node_idx, out_idx, is_aux)
+    const_ids: Dict[int, Any] = {}
+    # resolve each marked handle to its CURRENT array (rebinds since mark
+    # time — optimizer steps, x[:]= — must keep the variable attached)
+    var_entries = [marked[k] for k in order]
+    var_index = {id(v._data): i for i, (v, _, _) in enumerate(var_entries)}
+
+    const_index: Dict[int, int] = {}
+
+    def slot(arr):
+        k = id(arr)
+        if k in var_index:
+            return ("v", var_index[k])
+        if k in produced:
+            n, o, a = produced[k]
+            return ("n", n, o, a)
+        if k not in const_index:
+            const_index[k] = len(const_index)
+            const_ids[k] = arr
+        return ("c", const_index[k])
+
+    node_sigs = []
+    node_slots = []
+    for ni, node in enumerate(tape):
+        in_slots = [slot(a) for a in node.inputs]
+        aux_slots = [slot(a) for a in node.aux]
+        rng_slot = None
+        if node.rng is not None:
+            rng_slot = slot(node.rng)
+        for oi, oa in enumerate(node.outputs):
+            produced[id(oa)] = (ni, oi, False)
+        for oi, oa in enumerate(node.aux_outputs):
+            produced[id(oa)] = (ni, oi, True)
+        node_slots.append((in_slots, aux_slots, rng_slot))
+        node_sigs.append(
+            (node.op.name, attrs_key(node.attrs), node.is_train,
+             tuple(in_slots), tuple(aux_slots), rng_slot)
+        )
+
+    head_slots = []
+    for h in heads:
+        k = id(h._data)
+        if k in var_index:
+            head_slots.append(("v", var_index[k]))
+        elif k in produced:
+            n, o, a = produced[k]
+            head_slots.append(("n", n, o, a))
+        else:
+            raise MXNetError("backward head was not computed under record()")
+
+    var_vals = [v._data for v, _, _ in var_entries]
+    const_vals = list(const_ids.values())
+    reqs = tuple(req for _, _, req in var_entries)
+
+    sig = (
+        tuple(node_sigs),
+        tuple(head_slots),
+        reqs,
+        tuple((v.shape, str(v.dtype)) for v in var_vals),
+        tuple((getattr(c, "shape", ()), str(getattr(c, "dtype", ""))) for c in const_vals),
+        head_grads is None,
+    )
+
+    fn = _bwd_cache.get(sig)
+    if fn is None:
+        ops = [(node.op, dict(node.attrs), node.is_train) for node in tape]
+        slots_c = list(node_slots)
+        heads_c = list(head_slots)
+
+        def resolve(env_nodes, vvals, cvals, s):
+            if s[0] == "v":
+                return vvals[s[1]]
+            if s[0] == "c":
+                return cvals[s[1]]
+            _, n, o, a = s
+            return env_nodes[n][1][o] if a else env_nodes[n][0][o]
+
+        def replay(vvals, cvals):
+            env_nodes = []
+            for (op, attrs, is_train), (in_s, aux_s, rng_s) in zip(ops, slots_c):
+                ins = [resolve(env_nodes, vvals, cvals, s) for s in in_s]
+                auxs = [resolve(env_nodes, vvals, cvals, s) for s in aux_s]
+                rng = resolve(env_nodes, vvals, cvals, rng_s) if rng_s else None
+                outs, aux_out = op.impl(attrs, tuple(ins), tuple(auxs), OpContext(is_train, rng))
+                env_nodes.append((tuple(outs), tuple(aux_out)))
+            return [resolve(env_nodes, vvals, cvals, s) for s in heads_c]
+
+        def grad_fn(vvals, cvals, hgrads, old_grads):
+            outs, vjp = jax.vjp(lambda *vs: replay(list(vs), cvals), *vvals)
+            if hgrads is None:
+                hgrads = [jnp.ones_like(o) for o in outs]
+            grads = vjp(list(hgrads))
+            results = []
+            for g, req, old in zip(grads, reqs, old_grads):
+                if req == "null":
+                    results.append(old)
+                elif req == "add":
+                    results.append(old + g)
+                else:
+                    results.append(g)
+            return results
+
+        fn = jax.jit(grad_fn, static_argnames=())
+        _bwd_cache[sig] = fn
+
+    hg_vals = None if head_grads is None else [g._data for g in head_grads]
+    old_grads = [
+        (grad._data if grad is not None else jnp.zeros_like(v))
+        for (_, grad, _), v in zip(var_entries, var_vals)
+    ]
+    new_grads = fn(var_vals, const_vals, hg_vals, old_grads)
+    for (_, grad_nd, req), g in zip(var_entries, new_grads):
+        if req != "null" and grad_nd is not None:
+            grad_nd._data = g
+    if not retain_graph:
+        _state.tape = []
+
+
+def grad(heads, variables, head_grads=None, retain_graph=False, create_graph=False,
+         train_mode=True):
+    """Functional gradient of heads w.r.t. variables (returns new arrays)."""
+    from .ndarray import NDArray, zeros
+
+    grads = [zeros(v.shape, dtype=v.dtype) for v in variables]
+    mark_variables(variables, grads, "write")
+    backward(heads, head_grads, retain_graph=retain_graph, train_mode=train_mode)
+    return grads

@@ -1,0 +1,268 @@
+"""Graph executor.
+
+TPU-native analogue of src/executor/graph_executor.{h,cc} +
+include/mxnet/executor.h:34-104. Where the reference builds per-node engine
+ops with memory planning and bulk segments, this executor compiles the WHOLE
+symbolic graph into:
+
+- one jitted forward computation  (Forward,  graph_executor.cc:32), and
+- one jitted forward+backward computation (Backward, graph_executor.cc:45),
+  derived with jax.vjp — the analogue of nnvm::pass::Gradient
+  (graph_executor.cc:233) — with grad_req write/add/null semantics
+  (OpReqType, operator.h:24-37) applied in-graph. `add` accumulation donates
+  the old gradient buffer so XLA updates it in place (kAddTo ≡ donation).
+
+Memory planning, inplace reuse, and op fusion are XLA's buffer assignment —
+the PlanMemory/DetectInplaceAddTo passes have no hand-written counterpart
+here by design (SURVEY §7 translation table).
+
+The optional `shared_exec` reuses argument/grad buffers across executors
+(bucketing support, graph_executor.cc:452-564 shared pools).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import random as _random
+from .base import MXNetError
+from .context import Context, default_context
+from .ndarray import NDArray
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None, group2ctx=None, shared_exec=None):
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, Context) else (ctx[0] if ctx else default_context())
+        self._group2ctx = group2ctx
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+
+        # ---- normalize args into name->NDArray dicts
+        self.arg_dict: Dict[str, NDArray] = self._to_dict(args, arg_names, "args")
+        if shared_exec is not None:
+            # share buffers with the master executor (bucketing)
+            for n in arg_names:
+                if n in shared_exec.arg_dict and shared_exec.arg_dict[n].shape == self.arg_dict[n].shape:
+                    self.arg_dict[n] = shared_exec.arg_dict[n]
+        self.aux_dict: Dict[str, NDArray] = self._to_dict(aux_states or {}, aux_names, "aux")
+
+        # ---- grad_req per-arg
+        if isinstance(grad_req, str):
+            self.grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self.grad_req = dict(zip(arg_names, grad_req))
+        else:
+            self.grad_req = {n: grad_req.get(n, "null") for n in arg_names}
+
+        if args_grad is None:
+            self.grad_dict: Dict[str, NDArray] = {}
+        else:
+            self.grad_dict = self._to_dict(args_grad, arg_names, "args_grad", allow_missing=True)
+        if shared_exec is not None:
+            for n, g in shared_exec.grad_dict.items():
+                if n in self.grad_dict and g.shape == self.grad_dict[n].shape:
+                    self.grad_dict[n] = g
+        for n in arg_names:
+            if self.grad_req.get(n, "null") != "null" and n not in self.grad_dict:
+                self.grad_req[n] = "null"
+
+        self._arg_names = arg_names
+        self._aux_names = aux_names
+        self._eval_fn = symbol.build_eval()
+        self._fwd_cache: Dict[bool, Any] = {}
+        self._bwd_fn = None
+        self._fwd_bwd_fn = None
+        self.outputs: List[NDArray] = []
+        self._monitor_cb = None
+        self._rng_counter = 0
+        self._last_rng = None
+
+    @staticmethod
+    def _to_dict(values, names, what, allow_missing=False):
+        if values is None:
+            values = {}
+        if isinstance(values, dict):
+            out = dict(values)
+        else:
+            values = list(values)
+            if len(values) != len(names) and not allow_missing:
+                raise MXNetError(
+                    "%s: expected %d entries, got %d" % (what, len(names), len(values))
+                )
+            out = {n: v for n, v in zip(names, values) if v is not None}
+        missing = [n for n in names if n not in out]
+        if missing and not allow_missing and what != "args_grad":
+            raise MXNetError("%s missing entries for %s" % (what, missing))
+        return out
+
+    # --- compiled paths ---------------------------------------------------
+    def _get_fwd(self, is_train: bool):
+        fn = self._fwd_cache.get(is_train)
+        if fn is None:
+            eval_fn = self._eval_fn
+
+            def fwd(arg_values, aux_values, rng):
+                return eval_fn(arg_values, aux_values, is_train, rng)
+
+            fn = jax.jit(fwd)
+            self._fwd_cache[is_train] = fn
+        return fn
+
+    def _get_fwd_bwd(self):
+        """Fused forward+backward — ONE XLA computation for the whole
+        training step graph (north-star: single HLO per symbolic subgraph)."""
+        if self._fwd_bwd_fn is None:
+            eval_fn = self._eval_fn
+            grad_names = [n for n in self._arg_names if self.grad_req.get(n) != "null"]
+            reqs = tuple(self.grad_req[n] for n in grad_names)
+
+            def fwd_bwd(arg_values, aux_values, rng, head_grads, old_grads):
+                grad_vals = [arg_values[n] for n in grad_names]
+
+                def f(*gvals):
+                    av = dict(arg_values)
+                    for n, v in zip(grad_names, gvals):
+                        av[n] = v
+                    outs, aux_up = eval_fn(av, aux_values, True, rng)
+                    return outs, aux_up
+
+                (outs, aux_up), vjp = jax.vjp(lambda *g: f(*g), *grad_vals, has_aux=False)
+                if head_grads is None:
+                    head_grads = [jnp.ones_like(o) for o in outs]
+                grads = vjp((list(head_grads), {k: jnp.zeros_like(v) for k, v in aux_up.items()}))
+                new_grads = []
+                for g, req, old in zip(grads, reqs, old_grads):
+                    new_grads.append(old + g if req == "add" else g)
+                return outs, aux_up, new_grads
+
+            self._fwd_bwd_fn = jax.jit(fwd_bwd, donate_argnums=(4,))
+            self._grad_names = grad_names
+        return self._fwd_bwd_fn
+
+    def _next_rng(self):
+        self._last_rng = _random.next_key()
+        return self._last_rng
+
+    # --- public API (reference Executor::Forward/Backward) ----------------
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = v._data if isinstance(v, NDArray) else jnp.asarray(v)
+        arg_values = {n: a._data for n, a in self.arg_dict.items()}
+        aux_values = {n: a._data for n, a in self.aux_dict.items()}
+        rng = self._next_rng()
+        if self._monitor_cb is not None:
+            self._run_monitored(arg_values, aux_values, is_train, rng)
+        fn = self._get_fwd(bool(is_train))
+        outs, aux_up = fn(arg_values, aux_values, rng)
+        if is_train:
+            for n, v in aux_up.items():
+                self.aux_dict[n]._data = v
+        self.outputs = [NDArray(o) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        """Runs the fused forward+backward computation (the separate-call API
+        is preserved; the fused path keeps a single XLA executable — forward
+        activations are recomputed inside, XLA CSEs what it can)."""
+        if out_grads is not None and not isinstance(out_grads, (list, tuple)):
+            out_grads = [out_grads]
+        fn = self._get_fwd_bwd()
+        arg_values = {n: a._data for n, a in self.arg_dict.items()}
+        aux_values = {n: a._data for n, a in self.aux_dict.items()}
+        rng = self._last_rng if self._last_rng is not None else self._next_rng()
+        heads = None if out_grads is None else [g._data for g in out_grads]
+        old = [
+            self.grad_dict[n]._data if self.grad_req[n] == "add" else jnp.zeros_like(self.grad_dict[n]._data)
+            for n in self._grad_names_list()
+        ]
+        outs, aux_up, new_grads = fn(arg_values, aux_values, rng, heads, old)
+        for n, g in zip(self._grad_names_list(), new_grads):
+            self.grad_dict[n]._data = g
+        for n, v in aux_up.items():
+            self.aux_dict[n]._data = v
+        self.outputs = [NDArray(o) for o in outs]
+        return self.outputs
+
+    def forward_backward(self, out_grads=None, **kwargs):
+        """One fused train step: forward + backward in a single jitted call."""
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = v._data if isinstance(v, NDArray) else jnp.asarray(v)
+        self._next_rng()
+        return self.backward(out_grads)
+
+    def _grad_names_list(self):
+        self._get_fwd_bwd()
+        return self._grad_names
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def copy_params_from(self, arg_params, aux_params=None, allow_extra_params=False):
+        for n, v in (arg_params or {}).items():
+            if n in self.arg_dict:
+                self.arg_dict[n]._data = jnp.asarray(v.asnumpy() if isinstance(v, NDArray) else v, self.arg_dict[n]._data.dtype)
+            elif not allow_extra_params:
+                raise MXNetError("unknown argument %s" % n)
+        for n, v in (aux_params or {}).items():
+            if n in self.aux_dict:
+                self.aux_dict[n]._data = jnp.asarray(v.asnumpy() if isinstance(v, NDArray) else v, self.aux_dict[n]._data.dtype)
+            elif not allow_extra_params:
+                raise MXNetError("unknown aux state %s" % n)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Re-bind with new shapes (compile cache keyed by shape ⇒ cheap)."""
+        from . import ndarray as nd
+
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = {}
+        for n, shp in zip(self._arg_names, arg_shapes):
+            cur = self.arg_dict[n]
+            if tuple(cur.shape) == tuple(shp):
+                new_args[n] = cur
+            else:
+                new_args[n] = nd.zeros(shp, dtype=str(cur._data.dtype))
+        new_aux = {}
+        for n, shp in zip(self._aux_names, aux_shapes):
+            cur = self.aux_dict[n]
+            new_aux[n] = cur if tuple(cur.shape) == tuple(shp) else nd.zeros(shp, dtype=str(cur._data.dtype))
+        grads = None
+        if self.grad_dict:
+            grads = {n: nd.zeros(a.shape, dtype=str(a._data.dtype)) for n, a in new_args.items() if n in self.grad_dict}
+        return Executor(self._symbol, self._ctx, new_args, grads, self.grad_req, new_aux)
+
+    # --- monitor (reference graph_executor.cc:761-781 monitor callback) ---
+    def set_monitor_callback(self, callback):
+        self._monitor_cb = callback
+
+    def _run_monitored(self, arg_values, aux_values, is_train, rng):
+        """Eager re-evaluation reporting every intermediate output to the
+        monitor callback (Monitor support, python/mxnet/monitor.py)."""
+        sym = self._symbol
+        internals = sym.get_internals()
+        eval_fn = internals.build_eval()
+        outs, _ = eval_fn(arg_values, aux_values, is_train, rng)
+        for name, val in zip(internals.list_outputs(), outs):
+            self._monitor_cb(name, NDArray(val))
+
+    def print_summary(self):
+        return self._symbol.debug_str()

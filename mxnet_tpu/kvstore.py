@@ -1,0 +1,205 @@
+"""KVStore — the communication plane.
+
+TPU-native redesign of src/kvstore/ (SURVEY §2.1 #22-26, §5.8). The
+*interface* is the reference's: Init/Push/Pull over integer-or-string keys,
+set_updater/set_optimizer, rank/num_workers/barrier, type factory
+(`create('local'|'device'|'dist_sync'|'dist_device_sync'|'dist_async')`).
+
+The *mechanism* is not a parameter server: on TPU, gradients produced by a
+mesh-sharded executor are already all-reduced in-graph by XLA (ICI
+collectives inserted from sharding propagation — the CommDevice P2P reduce,
+comm.h:211-373, has no hand-written counterpart). What remains for the
+KVStore object is:
+
+- `local`/`device`: aggregate per-device gradient NDArrays (tree-sum on
+  device) and run the updater on the merged copy — matching
+  KVStoreLocal::Push/Pull (kvstore_local.h:50-88). With one sharded executor
+  the per-key list has a single, already-reduced entry.
+- `dist_sync`/`dist_device_sync`: the same code over a multi-host runtime
+  (jax.distributed): every host holds replicated weights, gradient arrays
+  are global jax.Arrays whose reduction rode ICI/DCN inside the step;
+  the updater is applied identically on every host (deterministic), which
+  IS the sync parameter-server semantics (kvstore_dist_server.h:164-198)
+  without the server round-trip.
+- `dist_async`: per-host immediate updates (Hogwild semantics,
+  kvstore_dist_server.h:199-207) — each host updates its own weight copy
+  without a barrier; drift is reconciled on explicit `pull` via mean.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from . import ndarray as nd
+from .base import MXNetError
+from .ndarray import NDArray
+
+
+class KVStore:
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store: Dict[Any, NDArray] = {}
+        self._updater: Optional[Callable] = None
+        self._optimizer = None
+        self._is_dist = "dist" in kv_type
+
+    # --- identity (reference kvstore.h:223-286) ---------------------------
+    @property
+    def rank(self) -> int:
+        return jax.process_index() if self._is_dist else 0
+
+    @property
+    def num_workers(self) -> int:
+        return jax.process_count() if self._is_dist else 1
+
+    def barrier(self):
+        """Global barrier (reference Barrier → ps::Postoffice::Barrier).
+        On jax runtime: a tiny all-reduce forces synchronization."""
+        if self._is_dist and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+    # --- data plane -------------------------------------------------------
+    def init(self, key, value):
+        """Initialize key(s) (reference KVStore::Init, kvstore.h:64)."""
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                raise MXNetError("duplicate init of key %r" % (k,))
+            self._store[k] = v.copy()
+
+    def push(self, key, value, priority=0):
+        """Reduce value(s) into the store; run updater if set
+        (reference KVStoreLocal::Push, kvstore_local.h:50-73).
+
+        value may be one NDArray or a list (one per device) per key."""
+        keys, grouped = _group_kv(key, value)
+        for k, vals in zip(keys, grouped):
+            merged = _reduce(vals)
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError("push to uninitialized key %r" % (k,))
+                stored = self._store[k]
+                # adopt the gradient's (mesh) sharding so the fused update
+                # runs where the executor's arrays live — the analogue of
+                # the reference's merge-buffer placement (comm.h:333-361)
+                if stored._data.sharding != merged._data.sharding:
+                    stored._data = jax.device_put(stored._data, merged._data.sharding)
+                self._updater(_updater_key(k), merged, stored)
+            else:
+                self._store[k] = merged
+
+    def pull(self, key, out=None, priority=0):
+        """Broadcast stored value into out array(s) (reference
+        KVStoreLocal::Pull → Comm::Broadcast, kvstore_local.h:75-88)."""
+        keys, grouped = _group_kv(key, out)
+        for k, outs in zip(keys, grouped):
+            if k not in self._store:
+                raise MXNetError("pull of uninitialized key %r" % (k,))
+            src = self._store[k]
+            for o in outs:
+                # broadcast into the target's own sharding (replicated over
+                # the mesh for params) — Comm::Broadcast (comm.h:268)
+                if o._data.sharding != src._data.sharding:
+                    o._data = jax.device_put(src._data, o._data.sharding)
+                else:
+                    o._data = src._data
+
+    # --- updater / optimizer ---------------------------------------------
+    def set_updater(self, updater):
+        self._updater = updater
+
+    _set_updater = set_updater
+
+    def set_optimizer(self, optimizer):
+        """Install an optimizer (reference kvstore.py set_optimizer: pickles
+        the optimizer to servers in dist mode; here every host constructs the
+        same updater and applies it deterministically)."""
+        from . import optimizer as opt
+
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def save_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("optimizer not set")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("optimizer not set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # --- liveness (reference kvstore_dist.h:159-168) ----------------------
+    def num_dead_node(self, node_id=0, timeout_sec=60):
+        """Dead-node query. jax.distributed's coordinator enforces liveness
+        (failed hosts abort the job), so a live process observes 0."""
+        return 0
+
+    def send_command_to_servers(self, head, body):
+        pass  # no server processes in the collective design
+
+    def __del__(self):
+        pass
+
+
+def _updater_key(k):
+    return int(k) if isinstance(k, (int, np.integer)) or (isinstance(k, str) and k.isdigit()) else k
+
+
+def _key_value(key, value):
+    if isinstance(key, (list, tuple)):
+        if isinstance(value, (list, tuple)) and len(key) == len(value):
+            return list(key), list(value)
+        raise MXNetError("key/value length mismatch")
+    return [key], [value]
+
+
+def _group_kv(key, value):
+    """Group duplicate keys (reference GroupKVPairs, kvstore_local.h:95-120)."""
+    if not isinstance(key, (list, tuple)):
+        key = [key]
+        value = [value]
+    keys: List[Any] = []
+    grouped: List[List[NDArray]] = []
+    pos: Dict[Any, int] = {}
+    for k, v in zip(key, value):
+        vals = v if isinstance(v, (list, tuple)) else [v]
+        if k in pos:
+            grouped[pos[k]].extend(vals)
+        else:
+            pos[k] = len(keys)
+            keys.append(k)
+            grouped.append(list(vals))
+    return keys, grouped
+
+
+def _reduce(vals: List[NDArray]) -> NDArray:
+    """Tree-sum on device — the CommDevice::Reduce analogue (comm.h:223).
+    For a single (possibly mesh-sharded) array this is a no-copy pass-through
+    because XLA already reduced it in-graph."""
+    if len(vals) == 1:
+        return NDArray(vals[0]._data)
+    acc = vals[0]._data
+    for v in vals[1:]:
+        acc = acc + v._data
+    return NDArray(acc)
+
+
+def create(name="local") -> KVStore:
+    """Factory (reference KVStore::Create, src/kvstore/kvstore.cc:17-45)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be string")
+    valid = (
+        "local", "device", "local_allreduce_cpu", "local_allreduce_device",
+        "dist_sync", "dist_device_sync", "dist_async", "dist_sync_device",
+    )
+    if name not in valid:
+        raise MXNetError("unknown kvstore type %r (valid: %s)" % (name, valid))
+    return KVStore(name)

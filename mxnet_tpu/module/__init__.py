@@ -1,0 +1,7 @@
+"""Training modules (reference python/mxnet/module/, SURVEY §2.4)."""
+from .base_module import BaseModule
+from .module import Module
+from .executor_group import DataParallelExecutorGroup
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule
+from .python_module import PythonModule, PythonLossModule

@@ -1,0 +1,552 @@
+"""Imperative NDArray API.
+
+TPU-native analogue of the reference NDArray
+(src/ndarray/ndarray.cc, include/mxnet/ndarray.h:58-421, python wrapper
+python/mxnet/ndarray.py). An NDArray is a mutable *handle* over an immutable
+``jax.Array``: in-place ops rebind the handle, which is exactly the
+reference's chunk-with-engine-var semantics mapped onto XLA's async runtime
+— dispatch is async (jax ops return futures over device buffers),
+``wait_to_read`` ≡ ``block_until_ready`` (ndarray.h:153-168).
+
+Every registered operator becomes a module-level function here, generated
+from the op registry at import — the same mechanism as the reference's
+ctypes-generated functions (python/mxnet/ndarray.py:28-39).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd as _autograd
+from . import random as _random
+from .base import MXNetError, attrs_key, dtype_mx_to_np, dtype_np_to_mx
+from .context import Context, default_context
+from .ops import OP_REGISTRY, OpContext, OpDef, get_op
+
+
+# generated op functions below shadow some builtins in this namespace
+# (slice, sum, max, min, abs); keep aliases for internal use
+_py_slice = slice
+_py_sum = sum
+_py_max = max
+_py_min = min
+_py_abs = abs
+
+
+def _as_jax_dtype(dtype):
+    if dtype is None:
+        return jnp.float32
+    if dtype == "bfloat16":
+        return jnp.bfloat16
+    return jnp.dtype(np.dtype(dtype))
+
+
+class NDArray:
+    """Mutable handle over an immutable jax.Array."""
+
+    __slots__ = ("_data", "_ctx", "_grad", "__weakref__")
+
+    def __init__(self, data, ctx: Optional[Context] = None):
+        if isinstance(data, NDArray):
+            data = data._data
+        self._data = data
+        self._ctx = ctx
+        self._grad = None
+
+    # --- metadata --------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(str(self._data.dtype)) if self._data.dtype != jnp.bfloat16 else self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        if self._ctx is not None:
+            return self._ctx
+        try:
+            dev = list(self._data.devices())[0]
+        except Exception:
+            return default_context()
+        if dev.platform == "cpu":
+            return Context("cpu", dev.id)
+        return Context("tpu", dev.id)
+
+    ctx = context
+
+    @property
+    def grad(self):
+        return self._grad
+
+    # --- sync / transfer --------------------------------------------------
+    def wait_to_read(self):
+        """Block until the value is computed (reference WaitToRead,
+        ndarray.h:153-160)."""
+        jax.block_until_ready(self._data)
+        return self
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def astype(self, dtype):
+        return NDArray(self._data.astype(_as_jax_dtype(dtype)))
+
+    def copy(self) -> "NDArray":
+        return NDArray(self._data + 0 if self._data.dtype != jnp.bool_ else self._data)
+
+    def copyto(self, other):
+        """Copy into another NDArray handle or to a context (reference
+        CopyFromTo, ndarray.cc:294-347)."""
+        if isinstance(other, NDArray):
+            if other.shape != self.shape:
+                raise MXNetError("copyto shape mismatch %s vs %s" % (other.shape, self.shape))
+            other._data = jax.device_put(self._data, _ctx_device(other.context)).astype(other._data.dtype)
+            return other
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, _ctx_device(other)), ctx=other)
+        raise MXNetError("copyto: unsupported target %r" % (other,))
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self.context:
+            return self
+        return self.copyto(ctx)
+
+    # --- shape ops (zero-copy views in the reference; functional here) ----
+    def reshape(self, shape):
+        if isinstance(shape, int):
+            shape = (shape,)
+        return NDArray(jnp.reshape(self._data, tuple(shape)))
+
+    T = property(lambda self: NDArray(self._data.T))
+
+    def slice(self, start, stop):
+        return NDArray(self._data[start:stop])
+
+    def flatten(self):
+        return NDArray(self._data.reshape(self.shape[0], -1))
+
+    def expand_dims(self, axis):
+        return NDArray(jnp.expand_dims(self._data, axis))
+
+    # --- indexing ---------------------------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, NDArray):
+            key = key._data.astype(jnp.int32)
+        return NDArray(self._data[key])
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value._data
+        if isinstance(key, _py_slice) and key == _py_slice(None):
+            if np.isscalar(value):
+                self._data = jnp.full_like(self._data, value)
+            else:
+                value = jnp.asarray(value, self._data.dtype)
+                self._data = jnp.broadcast_to(value, self.shape).astype(self._data.dtype)
+        else:
+            if isinstance(key, NDArray):
+                key = key._data.astype(jnp.int32)
+            self._data = self._data.at[key].set(value)
+
+    def __len__(self):
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(self.shape[0]):
+            yield self[i]
+
+    def __bool__(self):
+        return bool(self.asscalar())
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __repr__(self):
+        return "<NDArray %s @%s>\n%s" % (
+            "x".join(str(s) for s in self.shape),
+            self.context,
+            self.asnumpy(),
+        )
+
+    # --- arithmetic -------------------------------------------------------
+    def _binop(self, other, op, scalar_op, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return invoke(get_op(op), [a, b], {})[0]
+        return invoke(
+            get_op(scalar_op if not reverse else scalar_op.replace("_", "_r", 1)),
+            [self],
+            {"scalar": float(other)},
+        )[0]
+
+    def __add__(self, other):
+        return self._binop(other, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binop(other, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return self._binop(other, "broadcast_sub", "_minus_scalar", reverse=True)
+
+    def __mul__(self, other):
+        return self._binop(other, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binop(other, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        return self._binop(other, "broadcast_div", "_div_scalar", reverse=True)
+
+    def __mod__(self, other):
+        return self._binop(other, "broadcast_mod", "_mod_scalar")
+
+    def __rmod__(self, other):
+        return self._binop(other, "broadcast_mod", "_mod_scalar", reverse=True)
+
+    def __pow__(self, other):
+        return self._binop(other, "broadcast_power", "_power_scalar")
+
+    def __rpow__(self, other):
+        return self._binop(other, "broadcast_power", "_power_scalar", reverse=True)
+
+    def __neg__(self):
+        return invoke(get_op("negative"), [self], {})[0]
+
+    def __abs__(self):
+        return invoke(get_op("abs"), [self], {})[0]
+
+    def __iadd__(self, other):
+        out = self.__add__(other)
+        self._data = out._data
+        return self
+
+    def __isub__(self, other):
+        out = self.__sub__(other)
+        self._data = out._data
+        return self
+
+    def __imul__(self, other):
+        out = self.__mul__(other)
+        self._data = out._data
+        return self
+
+    def __itruediv__(self, other):
+        out = self.__truediv__(other)
+        self._data = out._data
+        return self
+
+    def __eq__(self, other):
+        if isinstance(other, (NDArray, int, float)):
+            return self._binop(other, "broadcast_equal", "_equal_scalar")
+        return NotImplemented
+
+    def __ne__(self, other):
+        if isinstance(other, (NDArray, int, float)):
+            return self._binop(other, "broadcast_not_equal", "_not_equal_scalar")
+        return NotImplemented
+
+    def __gt__(self, other):
+        return self._binop(other, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return self._binop(other, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, other):
+        return self._binop(other, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return self._binop(other, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    __hash__ = object.__hash__
+
+    # --- autograd ---------------------------------------------------------
+    def attach_grad(self, grad_req="write"):
+        grad = NDArray(jnp.zeros_like(self._data))
+        _autograd.mark_variables([self], [grad], grad_req)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        _autograd.backward(
+            [self],
+            None if out_grad is None else [out_grad],
+            retain_graph=retain_graph,
+            train_mode=train_mode,
+        )
+
+    # reductions / conveniences mirroring reference methods
+    def sum(self, axis=None, keepdims=False):
+        return invoke(get_op("sum"), [self], {"axis": axis, "keepdims": keepdims})[0]
+
+    def mean(self, axis=None, keepdims=False):
+        return invoke(get_op("mean"), [self], {"axis": axis, "keepdims": keepdims})[0]
+
+    def max(self, axis=None, keepdims=False):
+        return invoke(get_op("max"), [self], {"axis": axis, "keepdims": keepdims})[0]
+
+    def min(self, axis=None, keepdims=False):
+        return invoke(get_op("min"), [self], {"axis": axis, "keepdims": keepdims})[0]
+
+
+def _ctx_device(ctx: Context):
+    return ctx.jax_device()
+
+
+# --- imperative invoke ------------------------------------------------------
+@functools.lru_cache(maxsize=8192)
+def _jitted(op_name: str, akey, is_train: bool, n_inputs: int, n_aux: int, with_rng: bool):
+    op = get_op(op_name)
+    attrs = {k: _unfreeze(v) for k, v in akey}
+
+    def run(rng, *arrs):
+        inputs = arrs[:n_inputs]
+        aux = arrs[n_inputs:]
+        return op.impl(attrs, inputs, aux, OpContext(is_train, rng))
+
+    return jax.jit(run)
+
+
+def _unfreeze(v):
+    return v
+
+
+def invoke(op: OpDef, inputs: Sequence[NDArray], attrs: Dict[str, Any], out=None):
+    """Execute one operator imperatively — the analogue of MXImperativeInvoke
+    (src/c_api/c_api_ndarray.cc:324): resolve attrs, dispatch the jitted
+    kernel, record on the autograd tape when recording.
+
+    ``inputs`` is ordered arg_names + aux_names. Returns list of NDArrays
+    (outputs only); aux handles are mutated in place like the reference's
+    mutable inputs.
+    """
+    attrs = op.parse_attrs(attrs)
+    arg_names = op.get_arg_names(attrs)
+    aux_names = op.get_aux_names(attrs)
+    if op.variadic:
+        n_in = len(inputs)
+        n_aux = 0
+    else:
+        n_aux = len(aux_names)
+        n_in = len(inputs) - n_aux
+    in_arrays = tuple(x._data for x in inputs[:n_in])
+    aux_arrays = tuple(x._data for x in inputs[n_in:])
+    rng = _random.next_key() if op.needs_rng else None
+    is_train = _autograd.is_training()
+
+    fn = _jitted(op.name, attrs_key(attrs), is_train, n_in, n_aux, rng is not None)
+    outs, aux_out = fn(rng, *(in_arrays + aux_arrays))
+
+    if _autograd.is_recording():
+        _autograd.record_op(op, attrs, in_arrays, aux_arrays, rng, is_train, outs, aux_out)
+
+    # mutate aux handles (reference: mutable inputs updated by engine op)
+    for handle, new in zip(inputs[n_in:], aux_out):
+        handle._data = new
+
+    results = [NDArray(o) for o in outs]
+    if out is not None:
+        if isinstance(out, NDArray):
+            out = [out]
+        for tgt, res in zip(out, results):
+            tgt._data = res._data
+        results = list(out)
+    return results
+
+
+def _split_args(op: OpDef, args, kwargs):
+    """Split user args/kwargs into (ordered inputs, attr dict)."""
+    tensor_kwargs = {}
+    attrs = {}
+    for k, v in kwargs.items():
+        if isinstance(v, NDArray):
+            tensor_kwargs[k] = v
+        else:
+            attrs[k] = v
+    attrs.pop("name", None)
+    parsed = op.parse_attrs(attrs)
+    names = list(op.get_arg_names(parsed)) + list(op.get_aux_names(parsed))
+    if op.variadic:
+        inputs = list(args) + [tensor_kwargs[k] for k in sorted(tensor_kwargs)]
+        return inputs, attrs
+    inputs: List[Optional[NDArray]] = [None] * len(names)
+    for i, a in enumerate(args):
+        inputs[i] = a
+    for k, v in tensor_kwargs.items():
+        if k not in names:
+            raise MXNetError("%s: unexpected tensor argument %r" % (op.name, k))
+        inputs[names.index(k)] = v
+    filled = [x for x in inputs if x is not None]
+    if len(filled) != len(names):
+        missing = [n for n, x in zip(names, inputs) if x is None]
+        raise MXNetError("%s missing inputs %s" % (op.name, missing))
+    return filled, attrs
+
+
+def _make_nd_function(op: OpDef):
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        inputs, attrs = _split_args(op, args, kwargs)
+        results = invoke(op, inputs, attrs, out=out)
+        if op.get_num_outputs(op.parse_attrs(attrs)) == 1:
+            return results[0]
+        return results
+
+    fn.__name__ = op.py_name or op.name
+    fn.__doc__ = op.doc
+    return fn
+
+
+def _populate_namespace():
+    g = globals()
+    seen = {}
+    for name, op in OP_REGISTRY.items():
+        if id(op) in seen:
+            target = seen[id(op)]
+        else:
+            target = _make_nd_function(op)
+            seen[id(op)] = target
+        if name not in g:
+            g[name] = target
+        pub = op.py_name or name
+        if pub not in g:
+            g[pub] = target
+
+
+# --- creation / utility -----------------------------------------------------
+def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    if isinstance(source, NDArray):
+        source = source.asnumpy()
+    was_ndarray = isinstance(source, np.ndarray)
+    arr = np.asarray(source, dtype=np.dtype(dtype) if dtype and dtype != "bfloat16" else None)
+    if dtype is None and (not was_ndarray or arr.dtype == np.float64):
+        # reference semantics: python lists default to float32
+        # (python/mxnet/ndarray.py array); np arrays keep their dtype
+        arr = arr.astype(np.float32)
+    ctx = ctx or default_context()
+    data = jax.device_put(arr, _ctx_device(ctx))
+    if dtype == "bfloat16":
+        data = data.astype(jnp.bfloat16)
+    return NDArray(data, ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype=None) -> NDArray:
+    return zeros(shape, ctx, dtype)
+
+
+def zeros(shape, ctx=None, dtype=None) -> NDArray:
+    if isinstance(shape, int):
+        shape = (shape,)
+    ctx = ctx or default_context()
+    with jax.default_device(_ctx_device(ctx)):
+        return NDArray(jnp.zeros(tuple(shape), _as_jax_dtype(dtype)), ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype=None) -> NDArray:
+    if isinstance(shape, int):
+        shape = (shape,)
+    ctx = ctx or default_context()
+    with jax.default_device(_ctx_device(ctx)):
+        return NDArray(jnp.ones(tuple(shape), _as_jax_dtype(dtype)), ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype=None) -> NDArray:
+    if isinstance(shape, int):
+        shape = (shape,)
+    ctx = ctx or default_context()
+    with jax.default_device(_ctx_device(ctx)):
+        return NDArray(jnp.full(tuple(shape), val, _as_jax_dtype(dtype)), ctx=ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32") -> NDArray:
+    out = np.arange(start, stop, step, dtype=np.dtype(dtype))
+    if repeat != 1:
+        out = np.repeat(out, repeat)
+    return array(out, ctx=ctx, dtype=dtype)
+
+
+def onehot_encode(indices: NDArray, out: NDArray) -> NDArray:
+    """Reference _onehot_encode (ndarray.cc): one-hot into out's shape."""
+    depth = out.shape[1]
+    res = invoke(get_op("one_hot"), [indices], {"depth": depth})[0]
+    out._data = res._data.astype(out._data.dtype)
+    return out
+
+
+def concatenate(arrays: Sequence[NDArray], axis=0, always_copy=True) -> NDArray:
+    return NDArray(jnp.concatenate([a._data for a in arrays], axis=axis))
+
+
+def moveaxis(tensor: NDArray, source, destination) -> NDArray:
+    return NDArray(jnp.moveaxis(tensor._data, source, destination))
+
+
+def waitall():
+    """Block on all outstanding async work (reference Engine WaitForAll /
+    MXNDArrayWaitAll)."""
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def imdecode(buf, **kwargs):  # placed in mx.image in the full pipeline
+    raise NotImplementedError("use mxnet_tpu.image.imdecode")
+
+
+# --- save / load (checkpoint format, reference ndarray.h:334-343) -----------
+_NDLIST_MAGIC = 0x112
+
+
+def save(fname: str, data) -> None:
+    """Save dict/list of NDArrays (npz container with the reference's
+    arg:/aux: naming preserved by callers)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        payload = {k: np.asarray(v._data) for k, v in data.items()}
+        np.savez(fname, __format__="dict", **payload)
+    else:
+        payload = {("arr_%d" % i): np.asarray(v._data) for i, v in enumerate(data)}
+        np.savez(fname, __format__="list", **payload)
+    import os
+
+    if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
+        os.replace(fname + ".npz", fname)
+
+
+def load(fname: str):
+    with np.load(fname, allow_pickle=False) as f:
+        fmt = str(f["__format__"]) if "__format__" in f else "dict"
+        if fmt == "list":
+            keys = sorted(
+                (k for k in f.files if k.startswith("arr_")),
+                key=lambda s: int(s.split("_")[1]),
+            )
+            return [array(f[k]) for k in keys]
+        return {k: array(f[k]) for k in f.files if k != "__format__"}
+
+
+_populate_namespace()

@@ -1,0 +1,142 @@
+"""Broadcast and reduction operators.
+
+TPU-native equivalents of src/operator/tensor/broadcast_reduce_op*.{cc,h}
+and the hand-written reduce kernels in broadcast_reduce-inl.{h,cuh}
+(SURVEY §2.1 #17). On TPU there is nothing to hand-schedule: XLA lowers
+jnp reductions/broadcasts straight to efficient tiled loops, so these are
+thin declarative definitions.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import defop, alias
+
+
+def _norm_axis(axis, ndim):
+    if axis is None or axis == ():
+        return None
+    if isinstance(axis, (int, np.integer)):
+        axis = (int(axis),)
+    return tuple(int(a) % ndim for a in axis)
+
+
+def _reduce(name, fn, py_name=None, default_axis=None):
+    spec = {"axis": default_axis, "keepdims": False, "exclude": False}
+
+    def impl(attrs, data, _f=fn):
+        axis = _norm_axis(attrs["axis"], data.ndim)
+        if attrs.get("exclude") and axis is not None:
+            axis = tuple(i for i in range(data.ndim) if i not in axis)
+        return _f(data, axis=axis, keepdims=bool(attrs["keepdims"]))
+
+    defop(name, arg_names=("data",), param_spec=spec, py_name=py_name or name)(impl)
+
+
+_reduce("sum", jnp.sum)
+alias("sum", "sum_axis")
+_reduce("mean", jnp.mean)
+_reduce("prod", jnp.prod)
+_reduce("nansum", jnp.nansum)
+_reduce("nanprod", jnp.nanprod)
+_reduce("max", jnp.max)
+alias("max", "max_axis")
+_reduce("min", jnp.min)
+alias("min", "min_axis")
+
+
+@defop("norm", arg_names=("data",), param_spec={"ord": 2, "axis": None, "keepdims": False})
+def _norm(attrs, data):
+    """L2 (or L1) norm reduction (reference broadcast_reduce_op_value.cc norm)."""
+    axis = _norm_axis(attrs["axis"], data.ndim)
+    if attrs["ord"] == 1:
+        return jnp.sum(jnp.abs(data), axis=axis, keepdims=bool(attrs["keepdims"]))
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=axis, keepdims=bool(attrs["keepdims"])))
+
+
+def _arg_reduce(name, fn):
+    @defop(name, arg_names=("data",), param_spec={"axis": None, "keepdims": False})
+    def impl(attrs, data, _f=fn):
+        axis = attrs["axis"]
+        if axis is None:
+            out = _f(data.reshape(-1), axis=0)
+            return out.astype(data.dtype)
+        out = _f(data, axis=int(axis))
+        if attrs["keepdims"]:
+            out = jnp.expand_dims(out, int(axis))
+        # reference returns float indices (same dtype as input)
+        return out.astype(data.dtype)
+
+
+_arg_reduce("argmax", jnp.argmax)
+_arg_reduce("argmin", jnp.argmin)
+
+
+@defop("argmax_channel", arg_names=("data",), param_spec={})
+def _argmax_channel(attrs, data):
+    """argmax over axis 1 (reference broadcast_reduce_op_index.cc)."""
+    return jnp.argmax(data, axis=1).astype(data.dtype)
+
+
+# --- broadcasting binary ops (reference elemwise_binary_broadcast_op*.cc) ---
+def _broadcast_binary(name, fn):
+    defop(name, arg_names=("lhs", "rhs"), param_spec={})(
+        lambda attrs, lhs, rhs, _f=fn: _f(lhs, rhs)
+    )
+
+
+_broadcast_binary("broadcast_add", jnp.add)
+alias("broadcast_add", "broadcast_plus")
+_broadcast_binary("broadcast_sub", jnp.subtract)
+alias("broadcast_sub", "broadcast_minus")
+_broadcast_binary("broadcast_mul", jnp.multiply)
+_broadcast_binary("broadcast_div", jnp.divide)
+_broadcast_binary("broadcast_mod", jnp.mod)
+_broadcast_binary("broadcast_power", jnp.power)
+_broadcast_binary("broadcast_maximum", jnp.maximum)
+_broadcast_binary("broadcast_minimum", jnp.minimum)
+_broadcast_binary("broadcast_hypot", jnp.hypot)
+_broadcast_binary("broadcast_equal", lambda a, b: (a == b).astype(a.dtype))
+_broadcast_binary("broadcast_not_equal", lambda a, b: (a != b).astype(a.dtype))
+_broadcast_binary("broadcast_greater", lambda a, b: (a > b).astype(a.dtype))
+_broadcast_binary("broadcast_greater_equal", lambda a, b: (a >= b).astype(a.dtype))
+_broadcast_binary("broadcast_lesser", lambda a, b: (a < b).astype(a.dtype))
+_broadcast_binary("broadcast_lesser_equal", lambda a, b: (a <= b).astype(a.dtype))
+_broadcast_binary("broadcast_logical_and", lambda a, b: ((a != 0) & (b != 0)).astype(a.dtype))
+_broadcast_binary("broadcast_logical_or", lambda a, b: ((a != 0) | (b != 0)).astype(a.dtype))
+_broadcast_binary("broadcast_logical_xor", lambda a, b: ((a != 0) ^ (b != 0)).astype(a.dtype))
+
+
+@defop("broadcast_to", arg_names=("data",), param_spec={"shape": ()})
+def _broadcast_to(attrs, data):
+    """Broadcast to a target shape; 0 keeps the input dim (reference
+    broadcast_reduce_op_value.cc broadcast_to)."""
+    shape = tuple(attrs["shape"])
+    tgt = tuple(d if s == 0 else s for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@defop("broadcast_axis", arg_names=("data",), param_spec={"axis": (), "size": ()})
+def _broadcast_axis(attrs, data):
+    """Broadcast singleton axes to given sizes (reference broadcast_axis)."""
+    axes = attrs["axis"]
+    sizes = attrs["size"]
+    if isinstance(axes, (int, np.integer)):
+        axes, sizes = (axes,), (sizes,)
+    tgt = list(data.shape)
+    for a, s in zip(axes, sizes):
+        tgt[int(a)] = int(s)
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+alias("broadcast_axis", "broadcast_axes")
+
+
+@defop("where", arg_names=("condition", "x", "y"), param_spec={}, no_grad_inputs=("condition",))
+def _where(attrs, condition, x, y):
+    """Elementwise select (reference control_flow_op.cc where). Condition may
+    be same-shape or a leading-axis vector selecting whole rows."""
+    if condition.shape != x.shape and condition.ndim == 1:
+        condition = condition.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(condition != 0, x, y)

@@ -1,0 +1,179 @@
+"""Elementwise binary/scalar/unary operators.
+
+TPU-native equivalents of the reference's NNVM-style tensor ops
+(src/operator/tensor/elemwise_binary_op_basic.cc:11-80,
+elemwise_unary_op.cc, elemwise_binary_scalar_op*.cc, and the ~100 SimpleOp
+unary math ops noted at SURVEY §2.1 #17). Gradients come from jax.vjp over
+the composed graph, so only the forward kernels are defined; XLA fuses
+elementwise chains into surrounding matmuls/convs (no mshadow expression
+templates needed — the compiler does that job on TPU).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import defop
+
+
+def _binary(name, fn, py_name=None):
+    defop(
+        name,
+        arg_names=("lhs", "rhs"),
+        param_spec={},
+        py_name=py_name or name,
+    )(lambda attrs, lhs, rhs, _f=fn: _f(lhs, rhs))
+
+
+def _binary_scalar(name, fn, py_name=None):
+    defop(
+        name,
+        arg_names=("data",),
+        param_spec={"scalar": 0.0},
+        py_name=py_name or name,
+    )(lambda attrs, data, _f=fn: _f(data, jnp.asarray(attrs["scalar"], data.dtype)))
+
+
+def _unary(name, fn, py_name=None):
+    defop(name, arg_names=("data",), param_spec={}, py_name=py_name or name)(
+        lambda attrs, data, _f=fn: _f(data)
+    )
+
+
+# --- binary elementwise (reference: elemwise_binary_op_basic.cc) ------------
+_binary("elemwise_add", jnp.add, py_name="elemwise_add")
+_binary("elemwise_sub", jnp.subtract)
+_binary("elemwise_mul", jnp.multiply)
+_binary("elemwise_div", jnp.divide)
+_binary("_plus", jnp.add)
+_binary("_minus", jnp.subtract)
+_binary("_mul", jnp.multiply)
+_binary("_div", jnp.divide)
+_binary("_mod", jnp.mod)
+_binary("_power", jnp.power)
+_binary("_maximum", jnp.maximum)
+_binary("_minimum", jnp.minimum)
+_binary("_hypot", jnp.hypot)
+# logic ops return same-dtype 0/1 arrays like the reference
+_binary("_equal", lambda a, b: (a == b).astype(a.dtype))
+_binary("_not_equal", lambda a, b: (a != b).astype(a.dtype))
+_binary("_greater", lambda a, b: (a > b).astype(a.dtype))
+_binary("_greater_equal", lambda a, b: (a >= b).astype(a.dtype))
+_binary("_lesser", lambda a, b: (a < b).astype(a.dtype))
+_binary("_lesser_equal", lambda a, b: (a <= b).astype(a.dtype))
+
+# --- binary with scalar (reference: elemwise_binary_scalar_op*.cc) ----------
+_binary_scalar("_plus_scalar", jnp.add)
+_binary_scalar("_minus_scalar", jnp.subtract)
+_binary_scalar("_rminus_scalar", lambda x, s: s - x)
+_binary_scalar("_mul_scalar", jnp.multiply)
+_binary_scalar("_div_scalar", jnp.divide)
+_binary_scalar("_rdiv_scalar", lambda x, s: s / x)
+_binary_scalar("_mod_scalar", jnp.mod)
+_binary_scalar("_rmod_scalar", lambda x, s: jnp.mod(s, x))
+_binary_scalar("_power_scalar", jnp.power)
+_binary_scalar("_rpower_scalar", lambda x, s: jnp.power(s, x))
+_binary_scalar("_maximum_scalar", jnp.maximum)
+_binary_scalar("_minimum_scalar", jnp.minimum)
+_binary_scalar("_hypot_scalar", jnp.hypot)
+_binary_scalar("_equal_scalar", lambda x, s: (x == s).astype(x.dtype))
+_binary_scalar("_not_equal_scalar", lambda x, s: (x != s).astype(x.dtype))
+_binary_scalar("_greater_scalar", lambda x, s: (x > s).astype(x.dtype))
+_binary_scalar("_greater_equal_scalar", lambda x, s: (x >= s).astype(x.dtype))
+_binary_scalar("_lesser_scalar", lambda x, s: (x < s).astype(x.dtype))
+_binary_scalar("_lesser_equal_scalar", lambda x, s: (x <= s).astype(x.dtype))
+
+# --- unary math (reference: elemwise_unary_op.cc + SimpleOp registry) -------
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("rint", jnp.rint)
+_unary("round", jnp.round)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("trunc", jnp.trunc)
+_unary("fix", jnp.trunc)
+_unary("square", jnp.square)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log10", jnp.log10)
+_unary("log2", jnp.log2)
+_unary("log1p", jnp.log1p)
+_unary("expm1", jnp.expm1)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("gamma", lambda x: jnp.exp(jax.lax.lgamma(x)))
+_unary("gammaln", lambda x: jax.lax.lgamma(x))
+_unary("negative", jnp.negative)
+_unary("reciprocal", lambda x: 1.0 / x)
+_unary("relu", jax.nn.relu)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("softsign", jax.nn.soft_sign)
+_unary("erf", jax.lax.erf)
+_unary("erfinv", jax.lax.erf_inv)
+_unary("logical_not", lambda x: (x == 0).astype(x.dtype))
+
+_unary("_copy", lambda x: x, py_name="identity")
+_unary("stop_gradient", jax.lax.stop_gradient, py_name="stop_gradient")
+defop("BlockGrad", arg_names=("data",), param_spec={})(
+    lambda attrs, data: jax.lax.stop_gradient(data)
+)
+defop("make_loss", arg_names=("data",), param_spec={})(lambda attrs, data: data)
+
+
+@defop("Cast", arg_names=("data",), param_spec={"dtype": "float32"})
+def _cast(attrs, data):
+    """Cast to a new dtype (reference: src/operator/tensor/elemwise_unary_op.cc
+    Cast)."""
+    import numpy as np
+
+    return data.astype(jnp.dtype(np.dtype(attrs["dtype"])) if attrs["dtype"] != "bfloat16" else jnp.bfloat16)
+
+
+@defop("clip", arg_names=("data",), param_spec={"a_min": 0.0, "a_max": 1.0})
+def _clip(attrs, data):
+    """Clip values to [a_min, a_max] (reference: matrix_op.cc clip)."""
+    return jnp.clip(data, attrs["a_min"], attrs["a_max"])
+
+
+@defop(
+    "smooth_l1",
+    arg_names=("data",),
+    param_spec={"scalar": 1.0},
+)
+def _smooth_l1(attrs, data):
+    """Smooth-L1 (huber) used by detection heads (reference
+    elemwise_binary_scalar_op_extended.cc smooth_l1)."""
+    s2 = attrs["scalar"] ** 2
+    absx = jnp.abs(data)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * jnp.square(data), absx - 0.5 / s2)
+
+
+# variadic sum (reference ElementWiseSum / add_n, elemwise_sum.cc)
+@defop("add_n", arg_names=(), variadic=True, param_spec={"num_args": 0}, py_name="add_n")
+def _add_n(attrs, *inputs):
+    out = inputs[0]
+    for x in inputs[1:]:
+        out = out + x
+    return out
+
+
+from .registry import alias  # noqa: E402
+
+alias("add_n", "ElementWiseSum", "_sum")
+alias("_copy", "identity")

@@ -1,0 +1,135 @@
+"""Initialization and sampling operators.
+
+TPU-native equivalents of src/operator/tensor/init_op.cc (_zeros/_ones/
+_arange/zeros_like/ones_like) and sample_op.cc (uniform/normal with
+resource-managed PRNG — here the PRNG is a threaded jax key, SURVEY §2.1 #8).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import defop, alias
+
+
+def _np_dtype(d):
+    if d == "bfloat16":
+        return jnp.bfloat16
+    return jnp.dtype(np.dtype(d or "float32"))
+
+
+@defop("_zeros", arg_names=(), param_spec={"shape": (), "ctx": None, "dtype": "float32"})
+def _zeros(attrs):
+    return jnp.zeros(tuple(attrs["shape"]), _np_dtype(attrs["dtype"]))
+
+
+@defop("_ones", arg_names=(), param_spec={"shape": (), "ctx": None, "dtype": "float32"})
+def _ones(attrs):
+    return jnp.ones(tuple(attrs["shape"]), _np_dtype(attrs["dtype"]))
+
+
+@defop(
+    "_full",
+    arg_names=(),
+    param_spec={"shape": (), "ctx": None, "dtype": "float32", "value": 0.0},
+)
+def _full(attrs):
+    return jnp.full(tuple(attrs["shape"]), attrs["value"], _np_dtype(attrs["dtype"]))
+
+
+@defop(
+    "_arange",
+    arg_names=(),
+    param_spec={
+        "start": 0.0,
+        "stop": None,
+        "step": 1.0,
+        "repeat": 1,
+        "ctx": None,
+        "dtype": "float32",
+    },
+)
+def _arange(attrs):
+    out = jnp.arange(attrs["start"], attrs["stop"], attrs["step"], dtype=_np_dtype(attrs["dtype"]))
+    if attrs["repeat"] != 1:
+        out = jnp.repeat(out, int(attrs["repeat"]))
+    return out
+
+
+@defop("zeros_like", arg_names=("data",), param_spec={})
+def _zeros_like(attrs, data):
+    return jnp.zeros_like(data)
+
+
+@defop("ones_like", arg_names=("data",), param_spec={})
+def _ones_like(attrs, data):
+    return jnp.ones_like(data)
+
+
+@defop("_eye", arg_names=(), param_spec={"N": 0, "M": 0, "k": 0, "ctx": None, "dtype": "float32"})
+def _eye(attrs):
+    n = int(attrs["N"])
+    m = int(attrs["M"]) or n
+    return jnp.eye(n, m, k=int(attrs["k"]), dtype=_np_dtype(attrs["dtype"]))
+
+
+# --- sampling (reference sample_op.cc: _random_uniform / _random_normal) ----
+@defop(
+    "_random_uniform",
+    arg_names=(),
+    param_spec={"low": 0.0, "high": 1.0, "shape": (), "ctx": None, "dtype": "float32"},
+    needs_rng=True,
+    simple=False,
+)
+def _random_uniform(attrs, inputs, aux, ctx):
+    out = jax.random.uniform(
+        ctx.rng,
+        tuple(attrs["shape"]),
+        _np_dtype(attrs["dtype"]),
+        minval=attrs["low"],
+        maxval=attrs["high"],
+    )
+    return (out,), ()
+
+
+@defop(
+    "_random_normal",
+    arg_names=(),
+    param_spec={"loc": 0.0, "scale": 1.0, "shape": (), "ctx": None, "dtype": "float32"},
+    needs_rng=True,
+    simple=False,
+)
+def _random_normal(attrs, inputs, aux, ctx):
+    out = attrs["loc"] + attrs["scale"] * jax.random.normal(
+        ctx.rng, tuple(attrs["shape"]), _np_dtype(attrs["dtype"])
+    )
+    return (out,), ()
+
+
+alias("_random_uniform", "uniform", "_sample_uniform")
+alias("_random_normal", "normal", "_sample_normal")
+
+
+@defop(
+    "_random_gamma",
+    arg_names=(),
+    param_spec={"alpha": 1.0, "beta": 1.0, "shape": (), "ctx": None, "dtype": "float32"},
+    needs_rng=True,
+    simple=False,
+)
+def _random_gamma(attrs, inputs, aux, ctx):
+    out = jax.random.gamma(ctx.rng, attrs["alpha"], tuple(attrs["shape"]), _np_dtype(attrs["dtype"]))
+    return (out * attrs["beta"],), ()
+
+
+@defop(
+    "_random_exponential",
+    arg_names=(),
+    param_spec={"lam": 1.0, "shape": (), "ctx": None, "dtype": "float32"},
+    needs_rng=True,
+    simple=False,
+)
+def _random_exponential(attrs, inputs, aux, ctx):
+    out = jax.random.exponential(ctx.rng, tuple(attrs["shape"]), _np_dtype(attrs["dtype"]))
+    return (out / attrs["lam"],), ()

@@ -1,0 +1,308 @@
+"""Matrix / shape-manipulation / indexing / ordering operators.
+
+TPU-native equivalents of src/operator/tensor/{matrix_op,dot,indexing_op,
+ordering_op}.{cc,h} (SURVEY §2.1 #17). All static-shape by construction so
+XLA can tile them onto the MXU/VPU; `dot` maps to lax.dot_general which is
+the MXU primitive.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import defop, alias
+
+
+@defop("dot", arg_names=("lhs", "rhs"), param_spec={"transpose_a": False, "transpose_b": False})
+def _dot(attrs, lhs, rhs):
+    """Matrix product (reference: src/operator/tensor/dot.cc). For ndim>2 the
+    reference contracts the last axis of lhs with the first of rhs; matmuls
+    land on the MXU via lax.dot_general."""
+    if attrs["transpose_a"]:
+        lhs = jnp.moveaxis(lhs, 0, -1) if lhs.ndim > 2 else lhs.T
+    if attrs["transpose_b"]:
+        rhs = jnp.moveaxis(rhs, -1, 0) if rhs.ndim > 2 else rhs.T
+    if lhs.ndim == 1 and rhs.ndim == 1:
+        return jnp.dot(lhs, rhs)
+    return jnp.tensordot(lhs, rhs, axes=([lhs.ndim - 1], [0]))
+
+
+@defop(
+    "batch_dot",
+    arg_names=("lhs", "rhs"),
+    param_spec={"transpose_a": False, "transpose_b": False},
+)
+def _batch_dot(attrs, lhs, rhs):
+    """Batched matmul over leading axis (reference dot.cc batch_dot)."""
+    if attrs["transpose_a"]:
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if attrs["transpose_b"]:
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    return jnp.matmul(lhs, rhs)
+
+
+@defop("transpose", arg_names=("data",), param_spec={"axes": ()})
+def _transpose(attrs, data):
+    axes = tuple(attrs["axes"]) or None
+    return jnp.transpose(data, axes)
+
+
+@defop("SwapAxis", arg_names=("data",), param_spec={"dim1": 0, "dim2": 0})
+def _swapaxis(attrs, data):
+    """Swap two axes (reference src/operator/swapaxis.cc)."""
+    return jnp.swapaxes(data, int(attrs["dim1"]), int(attrs["dim2"]))
+
+
+alias("SwapAxis", "swapaxes")
+
+
+def _infer_reshape(data_shape, target):
+    """Reference reshape semantics incl. special codes 0,-1,-2,-3,-4
+    (src/operator/tensor/matrix_op.cc ReshapeShape)."""
+    out = []
+    src = list(data_shape)
+    i = 0  # index into src
+    t = list(target)
+    j = 0
+    while j < len(t):
+        k = t[j]
+        if k == 0:
+            out.append(src[i]); i += 1
+        elif k == -1:
+            out.append(-1); i += 1  # placeholder; src advance fixed below
+        elif k == -2:
+            out.extend(src[i:]); i = len(src)
+        elif k == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif k == -4:
+            a, b = t[j + 1], t[j + 2]
+            if a == -1:
+                a = src[i] // b
+            if b == -1:
+                b = src[i] // a
+            out.extend([a, b]); i += 1; j += 2
+        else:
+            out.append(int(k))
+        j += 1
+    if -1 in out:
+        known = int(np.prod([d for d in out if d != -1])) or 1
+        total = int(np.prod(data_shape)) if data_shape else 1
+        out[out.index(-1)] = total // known
+    return tuple(out)
+
+
+@defop("Reshape", arg_names=("data",), param_spec={"shape": (), "reverse": False, "target_shape": (), "keep_highest": False})
+def _reshape(attrs, data):
+    """Reshape with the reference's 0/-1/-2/-3/-4 codes (matrix_op.cc)."""
+    shape = tuple(attrs["shape"]) if attrs["shape"] else tuple(attrs["target_shape"])
+    return jnp.reshape(data, _infer_reshape(data.shape, shape))
+
+
+alias("Reshape", "reshape")
+
+
+@defop("Flatten", arg_names=("data",), param_spec={})
+def _flatten(attrs, data):
+    """Collapse all but the leading axis (reference matrix_op.cc Flatten)."""
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+alias("Flatten", "flatten")
+
+
+@defop("expand_dims", arg_names=("data",), param_spec={"axis": 0})
+def _expand_dims(attrs, data):
+    return jnp.expand_dims(data, int(attrs["axis"]))
+
+
+@defop("slice", arg_names=("data",), param_spec={"begin": (), "end": ()})
+def _slice(attrs, data):
+    """Static slice (reference matrix_op.cc slice / crop)."""
+    begin, end = attrs["begin"], attrs["end"]
+    idx = tuple(
+        slice(None if b is None else int(b), None if e is None else int(e))
+        for b, e in zip(begin, end)
+    )
+    return data[idx]
+
+
+alias("slice", "crop")
+
+
+@defop("slice_axis", arg_names=("data",), param_spec={"axis": 0, "begin": 0, "end": None})
+def _slice_axis(attrs, data):
+    ax = int(attrs["axis"]) % data.ndim
+    begin = int(attrs["begin"])
+    end = attrs["end"]
+    end = data.shape[ax] if end is None else int(end)
+    if end < 0:
+        end += data.shape[ax]
+    idx = [slice(None)] * data.ndim
+    idx[ax] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@defop(
+    "Concat",
+    arg_names=(),
+    variadic=True,
+    param_spec={"num_args": 0, "dim": 1},
+    py_name="concat",
+)
+def _concat(attrs, *inputs):
+    """Concatenate along an axis (reference src/operator/concat.cc)."""
+    return jnp.concatenate(inputs, axis=int(attrs["dim"]))
+
+
+alias("Concat", "concat")
+
+
+@defop(
+    "SliceChannel",
+    arg_names=("data",),
+    param_spec={"num_outputs": 1, "axis": 1, "squeeze_axis": False},
+    num_outputs=lambda attrs: int(attrs["num_outputs"]),
+    py_name="split",
+)
+def _slice_channel(attrs, data):
+    """Split along an axis into num_outputs parts (reference
+    src/operator/slice_channel.cc)."""
+    n = int(attrs["num_outputs"])
+    ax = int(attrs["axis"])
+    parts = jnp.split(data, n, axis=ax)
+    if attrs["squeeze_axis"]:
+        parts = [jnp.squeeze(p, axis=ax) for p in parts]
+    return tuple(parts)
+
+
+alias("SliceChannel", "split")
+
+
+@defop("repeat", arg_names=("data",), param_spec={"repeats": 1, "axis": None})
+def _repeat(attrs, data):
+    ax = attrs["axis"]
+    return jnp.repeat(data, int(attrs["repeats"]), axis=None if ax is None else int(ax))
+
+
+@defop("tile", arg_names=("data",), param_spec={"reps": ()})
+def _tile(attrs, data):
+    return jnp.tile(data, tuple(attrs["reps"]))
+
+
+@defop("reverse", arg_names=("data",), param_spec={"axis": ()})
+def _reverse(attrs, data):
+    axes = attrs["axis"]
+    if isinstance(axes, (int, np.integer)):
+        axes = (axes,)
+    return jnp.flip(data, axis=tuple(int(a) for a in axes))
+
+
+alias("reverse", "flip")
+
+
+@defop(
+    "Pad",
+    arg_names=("data",),
+    param_spec={"mode": "constant", "pad_width": (), "constant_value": 0.0},
+)
+def _pad(attrs, data):
+    """N-d padding, constant/edge/reflect (reference src/operator/pad.cc)."""
+    pw = attrs["pad_width"]
+    pairs = [(int(pw[2 * i]), int(pw[2 * i + 1])) for i in range(len(pw) // 2)]
+    mode = attrs["mode"]
+    if mode == "constant":
+        return jnp.pad(data, pairs, constant_values=attrs["constant_value"])
+    return jnp.pad(data, pairs, mode="edge" if mode == "edge" else "reflect")
+
+
+alias("Pad", "pad")
+
+
+# --- indexing (reference indexing_op.cc) ------------------------------------
+@defop(
+    "Embedding",
+    arg_names=("data", "weight"),
+    param_spec={"input_dim": 0, "output_dim": 0, "dtype": "float32"},
+    no_grad_inputs=("data",),
+)
+def _embedding(attrs, data, weight):
+    """Table lookup; backward is a scatter-add handled by jax.vjp of take
+    (reference indexing_op.cc Embedding + EmbeddingOpBackward)."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@defop("take", arg_names=("a", "indices"), param_spec={"axis": 0, "mode": "clip"}, no_grad_inputs=("indices",))
+def _take(attrs, a, indices):
+    mode = attrs["mode"]
+    return jnp.take(a, indices.astype(jnp.int32), axis=int(attrs["axis"]),
+                    mode="wrap" if mode == "wrap" else "clip")
+
+
+@defop("batch_take", arg_names=("a", "indices"), param_spec={}, no_grad_inputs=("indices",))
+def _batch_take(attrs, a, indices):
+    """Per-row gather: out[i] = a[i, indices[i]] (reference batch_take)."""
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32).reshape(-1, 1), axis=1
+    ).reshape(indices.shape)
+
+
+@defop(
+    "one_hot",
+    arg_names=("indices",),
+    param_spec={"depth": 0, "on_value": 1.0, "off_value": 0.0, "dtype": "float32"},
+    no_grad_inputs=("indices",),
+)
+def _one_hot(attrs, indices):
+    depth = int(attrs["depth"])
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=jnp.dtype(attrs["dtype"]))
+    return oh * (attrs["on_value"] - attrs["off_value"]) + attrs["off_value"]
+
+
+# --- ordering (reference ordering_op.cc) ------------------------------------
+@defop("sort", arg_names=("data",), param_spec={"axis": -1, "is_ascend": True})
+def _sort(attrs, data):
+    ax = attrs["axis"]
+    out = jnp.sort(data, axis=None if ax is None else int(ax))
+    if not attrs["is_ascend"]:
+        out = jnp.flip(out, axis=-1 if ax is None else int(ax))
+    return out
+
+
+@defop("argsort", arg_names=("data",), param_spec={"axis": -1, "is_ascend": True, "dtype": "float32"})
+def _argsort(attrs, data):
+    ax = attrs["axis"]
+    if not attrs["is_ascend"]:
+        data = -data
+    return jnp.argsort(data, axis=None if ax is None else int(ax)).astype(data.dtype)
+
+
+@defop(
+    "topk",
+    arg_names=("data",),
+    param_spec={"axis": -1, "k": 1, "ret_typ": "indices", "is_ascend": False, "dtype": "float32"},
+    num_outputs=lambda attrs: 2 if attrs.get("ret_typ") == "both" else 1,
+)
+def _topk(attrs, data):
+    """Top-k along an axis (reference ordering_op.cc). ret_typ selects
+    value/indices/both/mask."""
+    ax = int(attrs["axis"]) % data.ndim
+    k = int(attrs["k"])
+    moved = jnp.moveaxis(data, ax, -1)
+    if attrs["is_ascend"]:
+        vals, idx = jax.lax.top_k(-moved, k)
+        vals = -vals
+    else:
+        vals, idx = jax.lax.top_k(moved, k)
+    vals = jnp.moveaxis(vals, -1, ax)
+    idxf = jnp.moveaxis(idx, -1, ax).astype(data.dtype)
+    rt = attrs["ret_typ"]
+    if rt == "value":
+        return vals
+    if rt == "both":
+        return vals, idxf
+    if rt == "mask":
+        oh = jax.nn.one_hot(idx, moved.shape[-1], dtype=data.dtype).sum(-2)
+        return jnp.moveaxis(oh, -1, ax)
+    return idxf

@@ -1,0 +1,101 @@
+"""Fused optimizer update operators.
+
+TPU-native equivalents of src/operator/tensor/optimizer_op.cc (sgd_update,
+sgd_mom_update, adam_update, rmsprop_update, rmspropalex_update — SURVEY
+§2.1 #17), used by both the Python optimizers and the KVStore updater path.
+The reference mutates weight/state in place under engine ordering; here each
+op returns the updated tensors and callers rebind (with buffer donation under
+jit, XLA updates in place — same memory behaviour, functional API).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import defop
+
+
+def _prep_grad(grad, weight, attrs):
+    g = grad * attrs["rescale_grad"]
+    clip = attrs["clip_gradient"]
+    if clip is not None and clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    return g + attrs["wd"] * weight
+
+
+_COMMON = {"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0, "clip_gradient": -1.0}
+
+
+@defop("sgd_update", arg_names=("weight", "grad"), param_spec=dict(_COMMON))
+def _sgd_update(attrs, weight, grad):
+    """weight -= lr * (rescale*clip(grad) + wd*weight) (optimizer_op.cc)."""
+    return weight - attrs["lr"] * _prep_grad(grad, weight, attrs)
+
+
+@defop(
+    "sgd_mom_update",
+    arg_names=("weight", "grad", "mom"),
+    param_spec=dict(_COMMON, momentum=0.0),
+    num_outputs=2,
+)
+def _sgd_mom_update(attrs, weight, grad, mom):
+    """mom = momentum*mom - lr*g; weight += mom. Returns (weight, mom)."""
+    new_mom = attrs["momentum"] * mom - attrs["lr"] * _prep_grad(grad, weight, attrs)
+    return weight + new_mom, new_mom
+
+
+@defop(
+    "adam_update",
+    arg_names=("weight", "grad", "mean", "var"),
+    param_spec=dict(_COMMON, beta1=0.9, beta2=0.999, epsilon=1e-8),
+    num_outputs=3,
+)
+def _adam_update(attrs, weight, grad, mean, var):
+    """Adam fused step; returns (weight, mean, var). Bias correction is done
+    by the Python Optimizer via the lr schedule, as in the reference."""
+    g = grad * attrs["rescale_grad"]
+    clip = attrs["clip_gradient"]
+    if clip is not None and clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    g = g + attrs["wd"] * weight
+    mean_t = attrs["beta1"] * mean + (1 - attrs["beta1"]) * g
+    var_t = attrs["beta2"] * var + (1 - attrs["beta2"]) * jnp.square(g)
+    w_t = weight - attrs["lr"] * mean_t / (jnp.sqrt(var_t) + attrs["epsilon"])
+    return w_t, mean_t, var_t
+
+
+@defop(
+    "rmsprop_update",
+    arg_names=("weight", "grad", "n"),
+    param_spec=dict(_COMMON, gamma1=0.95, epsilon=1e-8, clip_weights=-1.0),
+    num_outputs=2,
+)
+def _rmsprop_update(attrs, weight, grad, n):
+    """RMSProp (Tieleman & Hinton) fused step; returns (weight, n)."""
+    g = _prep_grad(grad, weight, attrs)
+    n_t = (1 - attrs["gamma1"]) * jnp.square(g) + attrs["gamma1"] * n
+    w_t = weight - attrs["lr"] * g / jnp.sqrt(n_t + attrs["epsilon"])
+    cw = attrs["clip_weights"]
+    if cw is not None and cw > 0:
+        w_t = jnp.clip(w_t, -cw, cw)
+    return w_t, n_t
+
+
+@defop(
+    "rmspropalex_update",
+    arg_names=("weight", "grad", "n", "g", "delta"),
+    param_spec=dict(_COMMON, gamma1=0.95, gamma2=0.9, epsilon=1e-8, clip_weights=-1.0),
+    num_outputs=4,
+)
+def _rmspropalex_update(attrs, weight, grad, n, g_state, delta):
+    """RMSProp (Graves 2013 variant); returns (weight, n, g, delta)."""
+    g = _prep_grad(grad, weight, attrs)
+    n_t = (1 - attrs["gamma1"]) * jnp.square(g) + attrs["gamma1"] * n
+    g_t = (1 - attrs["gamma1"]) * g + attrs["gamma1"] * g_state
+    delta_t = attrs["gamma2"] * delta - attrs["lr"] * g / jnp.sqrt(
+        n_t - jnp.square(g_t) + attrs["epsilon"]
+    )
+    w_t = weight + delta_t
+    cw = attrs["clip_weights"]
+    if cw is not None and cw > 0:
+        w_t = jnp.clip(w_t, -cw, cw)
+    return w_t, n_t, g_t, delta_t

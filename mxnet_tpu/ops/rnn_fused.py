@@ -1,0 +1,208 @@
+"""Fused multi-layer RNN operator.
+
+TPU-native equivalent of the reference's cuDNN-only fused `RNN` op
+(src/operator/rnn.cc:14 — CPU forward aborts in the reference;
+cudnn_rnn-inl.h:22,127-267 wraps cudnnRNNForwardTraining). Here the fused
+kernel is a lax.scan over time per layer: the per-step gate matmuls are
+single large dot_generals on the MXU, weights stay resident, and XLA
+pipelines the scan — the idiomatic TPU counterpart of cuDNN's fused kernels.
+
+Parameter blob layout matches the reference/cuDNN packing (all i2h+h2h
+weights layer-major, then all biases) so FusedRNNCell._slice_weights and
+unpack_weights round-trip identically.
+
+Layouts: data (T, N, input_size); state (num_layers*dirs, N, H).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import defop, get_op
+
+_NUM_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(num_layers, input_size, state_size, mode, bidirectional=False):
+    """Total packed parameter count (reference rnn-inl.h GetRnnParamSize)."""
+    gates = _NUM_GATES[mode]
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        ni = input_size if layer == 0 else state_size * dirs
+        size += dirs * gates * state_size * (ni + state_size)  # weights
+    size += num_layers * dirs * gates * state_size * 2  # biases
+    return size
+
+
+def _unpack_params(params, num_layers, input_size, state_size, mode, dirs):
+    gates = _NUM_GATES[mode]
+    h = state_size
+    out = []
+    p = 0
+    for layer in range(num_layers):
+        ni = input_size if layer == 0 else h * dirs
+        layer_params = []
+        for _ in range(dirs):
+            wi = params[p : p + gates * h * ni].reshape(gates * h, ni)
+            p += gates * h * ni
+            wh = params[p : p + gates * h * h].reshape(gates * h, h)
+            p += gates * h * h
+            layer_params.append([wi, wh])
+        out.append(layer_params)
+    for layer in range(num_layers):
+        for d in range(dirs):
+            bi = params[p : p + gates * h]
+            p += gates * h
+            bh = params[p : p + gates * h]
+            p += gates * h
+            out[layer][d].extend([bi, bh])
+    return out
+
+
+def _lstm_scan(x_seq, h0, c0, wi, wh, bi, bh, h):
+    """One direction of one LSTM layer: scan over time; gate order i,f,g,o
+    (cuDNN order, matching FusedRNNCell._gate_names)."""
+    ib = x_seq @ wi.T + (bi + bh)  # (T, N, 4H): hoist input projection out of scan
+
+    def step(carry, xt):
+        h_prev, c_prev = carry
+        gates = xt + h_prev @ wh.T
+        i = jax.nn.sigmoid(gates[:, 0 * h : 1 * h])
+        f = jax.nn.sigmoid(gates[:, 1 * h : 2 * h])
+        g = jnp.tanh(gates[:, 2 * h : 3 * h])
+        o = jax.nn.sigmoid(gates[:, 3 * h : 4 * h])
+        c = f * c_prev + i * g
+        hh = o * jnp.tanh(c)
+        return (hh, c), hh
+
+    (h_last, c_last), ys = jax.lax.scan(step, (h0, c0), ib)
+    return ys, h_last, c_last
+
+
+def _gru_scan(x_seq, h0, wi, wh, bi, bh, h):
+    """GRU scan; gate order r,z,o (cuDNN/reference order)."""
+    ib = x_seq @ wi.T + bi  # (T, N, 3H)
+
+    def step(h_prev, xt):
+        hb = h_prev @ wh.T + bh
+        r = jax.nn.sigmoid(xt[:, 0 * h : 1 * h] + hb[:, 0 * h : 1 * h])
+        z = jax.nn.sigmoid(xt[:, 1 * h : 2 * h] + hb[:, 1 * h : 2 * h])
+        o = jnp.tanh(xt[:, 2 * h : 3 * h] + r * hb[:, 2 * h : 3 * h])
+        hh = (1 - z) * o + z * h_prev
+        return hh, hh
+
+    h_last, ys = jax.lax.scan(step, h0, ib)
+    return ys, h_last
+
+
+def _rnn_scan(x_seq, h0, wi, wh, bi, bh, h, act):
+    ib = x_seq @ wi.T + (bi + bh)
+
+    def step(h_prev, xt):
+        hh = act(xt + h_prev @ wh.T)
+        return hh, hh
+
+    h_last, ys = jax.lax.scan(step, h0, ib)
+    return ys, h_last
+
+
+@defop(
+    "RNN",
+    arg_names=lambda attrs: (
+        ("data", "parameters", "state", "state_cell")
+        if attrs.get("mode", "lstm") == "lstm"
+        else ("data", "parameters", "state")
+    ),
+    param_spec={
+        "state_size": 0,
+        "num_layers": 1,
+        "bidirectional": False,
+        "mode": "lstm",
+        "p": 0.0,
+        "state_outputs": False,
+        "pkeep_": 1.0,
+        "lstm_q_": False,
+    },
+    num_outputs=lambda attrs: (
+        1 if not attrs.get("state_outputs")
+        else (3 if attrs.get("mode", "lstm") == "lstm" else 2)
+    ),
+    uses_train=True,
+    needs_rng=True,
+    simple=False,
+)
+def _rnn(attrs, inputs, aux, ctx):
+    """Fused RNN forward (see module docstring). data: (T,N,I)."""
+    mode = attrs["mode"]
+    if mode == "lstm":
+        data, params, state, state_cell = inputs
+    else:
+        data, params, state = inputs
+        state_cell = None
+    h = int(attrs["state_size"])
+    num_layers = int(attrs["num_layers"])
+    dirs = 2 if attrs["bidirectional"] else 1
+    input_size = data.shape[2]
+    layer_params = _unpack_params(params, num_layers, input_size, h, mode, dirs)
+    dropout = float(attrs["p"])
+
+    x = data
+    h_states = []
+    c_states = []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(dirs):
+            wi, wh, bi, bh = layer_params[layer][d]
+            idx = layer * dirs + d
+            h0 = state[idx]
+            x_dir = x if d == 0 else jnp.flip(x, axis=0)
+            if mode == "lstm":
+                c0 = state_cell[idx]
+                ys, h_last, c_last = _lstm_scan(x_dir, h0, c0, wi, wh, bi, bh, h)
+                c_states.append(c_last)
+            elif mode == "gru":
+                ys, h_last = _gru_scan(x_dir, h0, wi, wh, bi, bh, h)
+            else:
+                act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+                ys, h_last = _rnn_scan(x_dir, h0, wi, wh, bi, bh, h, act)
+            if d == 1:
+                ys = jnp.flip(ys, axis=0)
+            outs.append(ys)
+            h_states.append(h_last)
+        x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=2)
+        if dropout > 0 and ctx.is_train and layer != num_layers - 1:
+            keep = 1.0 - dropout
+            mask = jax.random.bernoulli(jax.random.fold_in(ctx.rng, layer), keep, x.shape)
+            x = x * mask.astype(x.dtype) / keep
+
+    if not attrs["state_outputs"]:
+        return (x,), ()
+    h_out = jnp.stack(h_states, axis=0)
+    if mode == "lstm":
+        c_out = jnp.stack(c_states, axis=0)
+        return (x, h_out, c_out), ()
+    return (x, h_out), ()
+
+
+def _rnn_infer(attrs, shapes):
+    """Parameter-blob shape rule for simple_bind."""
+    data = shapes[0]
+    if data is None:
+        return shapes
+    size = rnn_param_size(
+        int(attrs["num_layers"]), data[2], int(attrs["state_size"]),
+        attrs["mode"], bool(attrs["bidirectional"]),
+    )
+    if shapes[1] is None:
+        shapes[1] = (size,)
+    dirs = 2 if attrs["bidirectional"] else 1
+    state_shape = (int(attrs["num_layers"]) * dirs, data[1], int(attrs["state_size"]))
+    for i in range(2, len(shapes)):
+        if shapes[i] is None:
+            shapes[i] = state_shape
+    return shapes
+
+
+get_op("RNN").infer_params = _rnn_infer

@@ -1,0 +1,140 @@
+"""Parameter-shape inference rules for layer ops.
+
+The reference's nnvm InferShape pass propagates shapes bidirectionally so
+that `simple_bind` can allocate weights from just the data shape
+(SURVEY §3.2, python/mxnet/symbol.py:815 infer_shape). On TPU, *output*
+shapes come for free from jax.eval_shape; the only genuinely reverse
+inference needed is "given data shape + attrs, what are the parameter/aux
+shapes". These per-op rules supply exactly that; everything else needs no
+rule.
+
+Each rule: fn(attrs, shapes: list[Optional[tuple]]) -> same-length list with
+parameter entries filled in. shapes is ordered arg_names + aux_names.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import get_op
+
+
+def _prod(xs):
+    return int(np.prod(xs)) if len(xs) else 1
+
+
+def _fc_infer(attrs, shapes):
+    data = shapes[0]
+    if data is None:
+        return shapes
+    num_hidden = int(attrs["num_hidden"])
+    in_dim = _prod(data[1:]) if attrs.get("flatten", True) else data[-1]
+    shapes[1] = shapes[1] or (num_hidden, in_dim)
+    if not attrs.get("no_bias") and len(shapes) > 2:
+        shapes[2] = shapes[2] or (num_hidden,)
+    return shapes
+
+
+def _conv_infer(attrs, shapes):
+    data = shapes[0]
+    if data is None:
+        return shapes
+    kernel = tuple(int(k) for k in attrs["kernel"])
+    nf = int(attrs["num_filter"])
+    group = int(attrs.get("num_group", 1))
+    shapes[1] = shapes[1] or (nf, data[1] // group) + kernel
+    if not attrs.get("no_bias") and len(shapes) > 2:
+        shapes[2] = shapes[2] or (nf,)
+    return shapes
+
+
+def _deconv_infer(attrs, shapes):
+    data = shapes[0]
+    if data is None:
+        return shapes
+    kernel = tuple(int(k) for k in attrs["kernel"])
+    nf = int(attrs["num_filter"])
+    group = int(attrs.get("num_group", 1))
+    shapes[1] = shapes[1] or (data[1], nf // group) + kernel
+    if not attrs.get("no_bias", True) and len(shapes) > 2:
+        shapes[2] = shapes[2] or (nf,)
+    return shapes
+
+
+def _bn_infer(attrs, shapes):
+    data = shapes[0]
+    if data is None:
+        return shapes
+    ax = int(attrs.get("axis", 1)) % len(data)
+    c = (data[ax],)
+    for i in range(1, len(shapes)):
+        shapes[i] = shapes[i] or c
+    return shapes
+
+
+def _embedding_infer(attrs, shapes):
+    shapes[1] = shapes[1] or (int(attrs["input_dim"]), int(attrs["output_dim"]))
+    return shapes
+
+
+def _prelu_infer(attrs, shapes):
+    data = shapes[0]
+    if data is None or len(shapes) < 2:
+        return shapes
+    shapes[1] = shapes[1] or (data[1] if len(data) > 1 else 1,)
+    return shapes
+
+
+def _upsampling_infer(attrs, shapes):
+    data = shapes[0]
+    if attrs.get("sample_type") == "bilinear" and data is not None and len(shapes) > 1:
+        s = int(attrs["scale"])
+        k = 2 * s - s % 2
+        shapes[1] = shapes[1] or (data[1], 1, k, k)
+    return shapes
+
+
+def _softmax_output_infer(attrs, shapes):
+    data = shapes[0]
+    if data is None or len(shapes) < 2:
+        return shapes
+    if attrs.get("multi_output"):
+        label = (data[0],) + tuple(data[2:])
+    elif attrs.get("preserve_shape"):
+        label = tuple(data[:-1])
+    else:
+        label = (data[0],)
+    shapes[1] = shapes[1] or label
+    return shapes
+
+
+def _regression_infer(attrs, shapes):
+    if shapes[0] is not None and len(shapes) > 1:
+        shapes[1] = shapes[1] or tuple(shapes[0])
+    return shapes
+
+
+def _label_vec_infer(attrs, shapes):
+    if shapes[0] is not None and len(shapes) > 1:
+        shapes[1] = shapes[1] or (shapes[0][0],)
+    return shapes
+
+
+def install():
+    get_op("SoftmaxOutput").infer_params = _softmax_output_infer
+    get_op("LinearRegressionOutput").infer_params = _regression_infer
+    get_op("MAERegressionOutput").infer_params = _regression_infer
+    get_op("LogisticRegressionOutput").infer_params = _regression_infer
+    get_op("SVMOutput").infer_params = _label_vec_infer
+    get_op("softmax_cross_entropy").infer_params = _label_vec_infer
+    get_op("FullyConnected").infer_params = _fc_infer
+    get_op("Convolution").infer_params = _conv_infer
+    get_op("Deconvolution").infer_params = _deconv_infer
+    get_op("BatchNorm").infer_params = _bn_infer
+    get_op("InstanceNorm").infer_params = _bn_infer
+    get_op("Embedding").infer_params = _embedding_infer
+    get_op("LeakyReLU").infer_params = _prelu_infer
+    get_op("IdentityAttachKLSparseReg").infer_params = _bn_infer
+    get_op("UpSampling").infer_params = _upsampling_infer
+
+
+install()

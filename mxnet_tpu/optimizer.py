@@ -1,0 +1,448 @@
+"""Optimizers.
+
+Reimplementation of python/mxnet/optimizer.py (SURVEY §2.4): registry +
+Optimizer base with lr/wd multipliers, the full zoo (SGD w/ momentum, NAG,
+SGLD, ccSGD, DCASGD, Adam, AdaGrad, RMSProp, AdaDelta, Ftrl, Test), and the
+Updater with state (de)serialization used by KVStore.
+
+The hot updates dispatch to the *fused* update ops
+(ops/optimizer_ops.py ≡ src/operator/tensor/optimizer_op.cc) so the whole
+step stays on device in one XLA computation.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from . import ndarray as nd
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["Optimizer", "SGD", "NAG", "SGLD", "ccSGD", "DCASGD", "Adam",
+           "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Test", "Updater",
+           "create", "register", "get_updater"]
+
+opt_registry: Dict[str, type] = {}
+
+
+def register(klass):
+    opt_registry[klass.__name__.lower()] = klass
+    return klass
+
+
+
+def _zeros_like_state(weight):
+    """State buffer matching the weight's dtype AND (mesh) sharding, so fused
+    updates run where the weight lives."""
+    import jax.numpy as jnp
+
+    return NDArray(jnp.zeros_like(weight._data))
+
+class Optimizer:
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+        if sym is not None:
+            attrs = sym.attr_dict()
+            for name in sym.list_arguments():
+                if name in attrs:
+                    if "__lr_mult__" in attrs[name]:
+                        self.lr_mult[name] = float(attrs[name]["__lr_mult__"])
+                    if "__wd_mult__" in attrs[name]:
+                        self.wd_mult[name] = float(attrs[name]["__wd_mult__"])
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in opt_registry:
+            return opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        """Reference semantics (optimizer.py set_wd_mult): params whose name
+        does not end in _weight/_gamma default to wd_mult 0, symbol attrs
+        override, explicit args override both."""
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attrs = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attrs and "__wd_mult__" in attrs[name]:
+                    self.wd_mult[name] = float(attrs[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        name = self.idx2name.get(index, index)
+        if name in self.lr_mult:
+            lr *= self.lr_mult[name]
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        name = self.idx2name.get(index, index)
+        if isinstance(name, str) and name not in self.wd_mult:
+            # reference default: no decay for bias / bn params
+            if name.endswith("_bias") or name.endswith("_gamma") or name.endswith("_beta"):
+                wd = 0.0
+        if name in self.wd_mult:
+            wd *= self.wd_mult[name]
+        return wd
+
+    def _clip_attr(self):
+        return -1.0 if self.clip_gradient is None else self.clip_gradient
+
+
+# convenience alias (reference keeps `create` at module level)
+def create(name, **kwargs):
+    return Optimizer.create_optimizer(name, **kwargs)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum using the fused sgd(_mom)_update kernels."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _zeros_like_state(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        attrs = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
+                 "clip_gradient": self._clip_attr()}
+        if state is None:
+            nd.sgd_update(weight, grad, out=weight, **attrs)
+        else:
+            res = nd.sgd_mom_update(weight, grad, state, momentum=self.momentum, **attrs)
+            weight._data = res[0]._data
+            state._data = res[1]._data
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference optimizer.py NAG)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        if state is not None:
+            mom = state
+            mom._data = (mom * self.momentum)._data
+            g = g + wd * weight
+            mom._data = (mom + g)._data
+            g = g + self.momentum * mom
+            weight._data = (weight - lr * g)._data
+        else:
+            weight._data = (weight - lr * (g + wd * weight))._data
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference optimizer.py SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        noise = nd.array(
+            np.random.normal(0, math.sqrt(lr), size=weight.shape).astype(np.float32),
+            ctx=weight.context,
+        )
+        weight._data = (weight - (lr / 2) * (g + wd * weight) + noise)._data
+
+
+@register
+class ccSGD(SGD):
+    """Kept for API parity (reference ccSGD is SGD with C++ impl)."""
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (_zeros_like_state(weight), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        mon, previous_weight = state
+        comp = g + wd * weight + self.lamda * g * g * (weight - previous_weight)
+        if mon is not None:
+            mon._data = (self.momentum * mon - lr * comp)._data
+            delta = mon
+        else:
+            delta = -lr * comp
+        previous_weight._data = weight._data
+        weight._data = (weight + delta)._data
+
+
+@register
+class Adam(Optimizer):
+    """Adam using the fused adam_update kernel; bias correction folded into
+    lr as in the reference (optimizer.py Adam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like_state(weight), _zeros_like_state(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        res = nd.adam_update(
+            weight, grad, mean, var, lr=lr, wd=wd, beta1=self.beta1, beta2=self.beta2,
+            epsilon=self.epsilon, rescale_grad=self.rescale_grad,
+            clip_gradient=self._clip_attr(),
+        )
+        weight._data = res[0]._data
+        mean._data = res[1]._data
+        var._data = res[2]._data
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return _zeros_like_state(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        history = state
+        history._data = (history + g * g)._data
+        weight._data = (weight - lr * (g / nd.sqrt(history + self.float_stable_eps) + wd * weight))._data
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp; centered=True selects the Graves'13 variant, matching the
+    fused rmsprop_update / rmspropalex_update split (optimizer.py RMSProp)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (_zeros_like_state(weight), _zeros_like_state(weight),
+                    _zeros_like_state(weight))
+        return (_zeros_like_state(weight),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
+                  "gamma1": self.gamma1, "epsilon": self.epsilon,
+                  "clip_gradient": self._clip_attr(),
+                  "clip_weights": self.clip_weights if self.clip_weights else -1.0}
+        if not self.centered:
+            (n,) = state
+            res = nd.rmsprop_update(weight, grad, n, **kwargs)
+            weight._data = res[0]._data
+            n._data = res[1]._data
+        else:
+            n, g, delta = state
+            res = nd.rmspropalex_update(weight, grad, n, g, delta,
+                                        gamma2=self.gamma2, **kwargs)
+            weight._data = res[0]._data
+            n._data = res[1]._data
+            g._data = res[2]._data
+            delta._data = res[3]._data
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like_state(weight), _zeros_like_state(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g._data = (self.rho * acc_g + (1 - self.rho) * g * g)._data
+        current_delta = nd.sqrt(acc_delta + self.epsilon) / nd.sqrt(acc_g + self.epsilon) * g
+        acc_delta._data = (self.rho * acc_delta + (1 - self.rho) * current_delta * current_delta)._data
+        weight._data = (weight - current_delta - wd * weight)._data
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (_zeros_like_state(weight), _zeros_like_state(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        z, n_ = state
+        sigma = -nd.sqrt(n_)
+        n_._data = (n_ + g * g)._data
+        sigma += nd.sqrt(n_)
+        sigma /= lr
+        z._data = (z + g - sigma * weight)._data
+        w_np = z.asnumpy()
+        n_np = n_.asnumpy()
+        new_w = np.where(
+            np.abs(w_np) > self.lamda1,
+            -(w_np - np.sign(w_np) * self.lamda1)
+            / ((self.beta + np.sqrt(n_np)) / lr + wd),
+            0.0,
+        ).astype(np.float32)
+        weight[:] = new_w
+
+
+@register
+class Test(Optimizer):
+    """Simple test optimizer (reference optimizer.py Test)."""
+
+    def create_state(self, index, weight):
+        return _zeros_like_state(weight)
+
+    def update(self, index, weight, grad, state):
+        weight._data = (weight + grad * self.rescale_grad)._data
+        state._data = weight._data
+
+
+class Updater:
+    """Closure applying an optimizer keyed by integer index — the object the
+    reference installs into KVStore (optimizer.py get_updater / :768ff)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        blob = pickle.loads(states)
+        restored = {}
+        for k, v in blob.items():
+            if isinstance(v, tuple):
+                restored[k] = tuple(None if x is None else nd.array(x) for x in v)
+            elif v is None:
+                restored[k] = None
+            else:
+                restored[k] = nd.array(v)
+        self.states = restored
+
+    def get_states(self):
+        def conv(v):
+            if v is None:
+                return None
+            if isinstance(v, tuple):
+                return tuple(None if x is None else x.asnumpy() for x in v)
+            return v.asnumpy()
+
+        return pickle.dumps({k: conv(v) for k, v in self.states.items()})
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

@@ -1,0 +1,59 @@
+"""Profiler controls.
+
+TPU-native analogue of python/mxnet/profiler.py + src/engine/profiler.cc
+(SURVEY §5.1). The reference stamps per-op begin/end in engine workers and
+dumps chrome://tracing JSON. Here the equivalent machinery is jax.profiler
+(XLA traces → TensorBoard/perfetto, which chrome://tracing reads); this
+module preserves the reference API surface and maps it onto jax.profiler.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+_state = {"running": False, "dir": None, "filename": "profile.json"}
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """(reference profiler.py profiler_set_config / MXSetProfilerConfig)."""
+    _state["filename"] = filename
+    _state["dir"] = os.path.dirname(os.path.abspath(filename)) or "."
+
+
+def profiler_set_state(state="stop"):
+    """(reference profiler.py profiler_set_state / MXSetProfilerState).
+    'run' starts a jax.profiler trace; 'stop' ends it and writes the trace
+    directory next to the configured filename."""
+    import jax
+
+    if state == "run" and not _state["running"]:
+        trace_dir = (_state["dir"] or ".") + "/jax_trace"
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        _state["running"] = True
+    elif state == "stop" and _state["running"]:
+        jax.profiler.stop_trace()
+        _state["running"] = False
+        logging.info("profiler trace written under %s/jax_trace", _state["dir"] or ".")
+
+
+def dump_profile():
+    """(reference MXDumpProfile) — stop and flush."""
+    if _state["running"]:
+        profiler_set_state("stop")
+
+
+class TraceAnnotation:
+    """Named region annotation visible in the trace (reference per-op
+    OprExecStat naming; here jax.profiler.TraceAnnotation)."""
+
+    def __init__(self, name, **kwargs):
+        import jax
+
+        self._ctx = jax.profiler.TraceAnnotation(name, **kwargs)
+
+    def __enter__(self):
+        return self._ctx.__enter__()
+
+    def __exit__(self, *a):
+        return self._ctx.__exit__(*a)

@@ -1,0 +1,32 @@
+"""Global PRNG state.
+
+The reference hands engine-tracked PRNG streams to operators through the
+resource manager (src/resource.cc:21-50, ResourceRequest::kRandom). Here the
+equivalent is a process-global jax PRNG key that is split per use — callers
+under jit receive an explicit key instead (functional randomness).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_lock = threading.Lock()
+_state = {"key": None, "seed": 0}
+
+
+def seed(seed_state: int) -> None:
+    """Seed the global generator (reference: python/mxnet/random.py seed /
+    MXRandomSeed)."""
+    with _lock:
+        _state["seed"] = int(seed_state)
+        _state["key"] = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split a fresh key off the global stream."""
+    with _lock:
+        if _state["key"] is None:
+            _state["key"] = jax.random.PRNGKey(_state["seed"])
+        _state["key"], sub = jax.random.split(_state["key"])
+        return sub

@@ -1,0 +1,214 @@
+"""RecordIO — binary-compatible record file format.
+
+Reimplementation of python/mxnet/recordio.py + dmlc-core recordio
+(SURVEY §2.1 #27, #36). The on-disk format matches the reference so .rec
+datasets packed by the original im2rec are readable:
+
+record  = [kMagic uint32][lrec uint32][data][pad to 4B]
+lrec    = cflag<<29 | length   (cflag: 0=whole, 1=start, 2=middle, 3=end)
+IRHeader = struct {uint32 flag; float label; uint64 id; uint64 id2}
+           + (flag>1 ? flag*float32 labels : inline label)
+"""
+from __future__ import annotations
+
+import ctypes
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+_KMAGIC = 0xCED7230A
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+
+
+class MXRecordIO:
+    """Sequential reader/writer (reference recordio.py MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fp = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fp = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def __del__(self):
+        self.close()
+
+    def close(self):
+        if self.is_open:
+            self.fp.close()
+            self.is_open = False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.fp.tell()
+
+    def write(self, buf):
+        assert self.writable
+        length = len(buf)
+        self.fp.write(struct.pack("II", _KMAGIC, length & ((1 << 29) - 1)))
+        self.fp.write(buf)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.fp.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        header = self.fp.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("II", header)
+        if magic != _KMAGIC:
+            raise IOError("Invalid magic number in record file %s" % self.uri)
+        length = lrec & ((1 << 29) - 1)
+        buf = self.fp.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.fp.read(pad)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Indexed random-access reader/writer (reference MXIndexedRecordIO).
+    .idx file: "<key>\\t<byte offset>\\n" per record."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if not self.is_open:
+            return
+        if self.writable:
+            with open(self.idx_path, "w") as fout:
+                for key in self.keys:
+                    fout.write("%s\t%d\n" % (str(key), self.idx[key]))
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self.fp.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        assert self.writable
+        key = self.key_type(idx)
+        self.idx[key] = self.tell()
+        self.keys.append(key)
+        self.write(buf)
+
+
+def pack(header, s):
+    """Pack a header + byte payload (reference recordio.py pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(flag=0)
+        payload = struct.pack(_IR_FORMAT, header.flag, header.label, header.id, header.id2) + s
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        payload = (
+            struct.pack(_IR_FORMAT, header.flag, header.label, header.id, header.id2)
+            + label.tobytes()
+            + s
+        )
+    return payload
+
+
+def unpack(s):
+    """(reference recordio.py unpack)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[: header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4 :]
+    return header, s
+
+
+def unpack_img(s, iscolor=-1):
+    """(reference recordio.py unpack_img) — requires cv2 or PIL."""
+    header, s = unpack(s)
+    img = _imdecode(np.frombuffer(s, dtype=np.uint8), iscolor)
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """(reference recordio.py pack_img)."""
+    encoded = _imencode(img, quality, img_fmt)
+    return pack(header, encoded)
+
+
+def _imdecode(buf, iscolor=-1):
+    try:
+        import cv2
+
+        return cv2.imdecode(buf, iscolor)
+    except ImportError:
+        from io import BytesIO
+
+        from PIL import Image
+
+        img = np.asarray(Image.open(BytesIO(buf.tobytes())))
+        if img.ndim == 3:
+            img = img[:, :, ::-1]  # RGB -> BGR to match cv2 convention
+        return img
+
+
+def _imencode(img, quality=95, img_fmt=".jpg"):
+    try:
+        import cv2
+
+        if img_fmt.lower() in (".jpg", ".jpeg"):
+            params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+        else:
+            params = [cv2.IMWRITE_PNG_COMPRESSION, 3]
+        ret, buf = cv2.imencode(img_fmt, img, params)
+        assert ret
+        return buf.tobytes()
+    except ImportError:
+        from io import BytesIO
+
+        from PIL import Image
+
+        arr = img[:, :, ::-1] if img.ndim == 3 else img  # BGR -> RGB
+        bio = BytesIO()
+        fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+        Image.fromarray(arr).save(bio, format=fmt, quality=quality)
+        return bio.getvalue()

@@ -1,0 +1,8 @@
+"""Recurrent networks (reference python/mxnet/rnn/)."""
+from .rnn_cell import (
+    BaseRNNCell, RNNCell, LSTMCell, GRUCell, FusedRNNCell,
+    SequentialRNNCell, BidirectionalCell, DropoutCell, ZoneoutCell,
+    ResidualCell, ModifierCell, RNNParams,
+)
+from .rnn import save_rnn_checkpoint, load_rnn_checkpoint, do_rnn_checkpoint
+from .io import BucketSentenceIter, encode_sentences

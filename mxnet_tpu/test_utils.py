@@ -1,0 +1,163 @@
+"""Testing utilities.
+
+TPU-native port of the reference's verification harness
+(python/mxnet/test_utils.py): numeric gradient checking by central
+differences (test_utils.py:360 check_numeric_gradient), symbolic
+forward/backward checks (:473, :538), and cross-device consistency
+(:705 check_consistency) where the "devices" are XLA cpu/tpu backends.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import ndarray as nd
+from .context import Context, cpu, default_context
+from .base import MXNetError
+
+
+def default_dtype():
+    return np.float32
+
+
+def rand_ndarray(shape, ctx=None, dtype=np.float32):
+    return nd.array(np.random.uniform(-1.0, 1.0, size=shape).astype(dtype), ctx=ctx)
+
+
+def same(a, b):
+    return np.array_equal(a, b)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-8, names=("a", "b")):
+    a = a.asnumpy() if isinstance(a, nd.NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, nd.NDArray) else np.asarray(b)
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                               err_msg="%s != %s" % names)
+
+
+def _as_shape_dict(sym, location):
+    if isinstance(location, dict):
+        return {k: np.asarray(v.asnumpy() if isinstance(v, nd.NDArray) else v, dtype=np.float32)
+                if not isinstance(v, np.ndarray) else v for k, v in location.items()}
+    names = sym.list_arguments()
+    return dict(zip(names, [np.asarray(v.asnumpy() if isinstance(v, nd.NDArray) else v) for v in location]))
+
+
+def _bind(sym, location, aux=None, grad_req="write", ctx=None):
+    ctx = ctx or default_context()
+    args = {k: nd.array(v, ctx=ctx) for k, v in location.items()}
+    grads = {k: nd.zeros(v.shape, ctx=ctx) for k, v in location.items()} if grad_req != "null" else None
+    aux_states = {k: nd.array(v, ctx=ctx) for k, v in (aux or {}).items()}
+    if aux_states:
+        missing = [n for n in sym.list_auxiliary_states() if n not in aux_states]
+    else:
+        aux_names = sym.list_auxiliary_states()
+        if aux_names:
+            shapes = {k: v.shape for k, v in location.items()}
+            _, _, aux_shapes = sym.infer_shape(**shapes)
+            aux_states = {n: nd.zeros(s, ctx=ctx) for n, s in zip(aux_names, aux_shapes)}
+    return sym.bind(ctx, args, grads, grad_req, aux_states)
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=1e-6,
+                           aux_states=None, ctx=None):
+    """Run forward and compare against expected numpy outputs
+    (reference test_utils.py:473)."""
+    location = _as_shape_dict(sym, location)
+    exe = _bind(sym, location, aux_states, "null", ctx)
+    outputs = exe.forward(is_train=False)
+    for out, exp in zip(outputs, expected):
+        assert_almost_equal(out, exp, rtol, atol)
+    return [o.asnumpy() for o in outputs]
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-4,
+                            atol=1e-6, aux_states=None, grad_req="write", ctx=None):
+    """Run backward with given head grads and compare input grads
+    (reference test_utils.py:538)."""
+    location = _as_shape_dict(sym, location)
+    exe = _bind(sym, location, aux_states, grad_req, ctx)
+    exe.forward(is_train=True)
+    exe.backward([nd.array(g) for g in out_grads])
+    if isinstance(expected, dict):
+        for name, exp in expected.items():
+            assert_almost_equal(exe.grad_dict[name], exp, rtol, atol, names=(name, "expected"))
+    else:
+        for g, exp in zip(exe.grad_arrays, expected):
+            if exp is not None:
+                assert_almost_equal(g, exp, rtol, atol)
+    return {k: v.asnumpy() for k, v in exe.grad_dict.items()}
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None, ctx=None):
+    """Central-difference gradient check (reference test_utils.py:360).
+
+    Computes analytic grads via the executor's fused backward, then perturbs
+    each input elementwise to form the numeric estimate.
+    """
+    location = _as_shape_dict(sym, location)
+    grad_nodes = grad_nodes or list(location.keys())
+    exe = _bind(sym, location, aux_states, grad_req={"write": "write"} and
+                {k: ("write" if k in grad_nodes else "null") for k in location}, ctx=ctx)
+    exe.forward(is_train=True)
+    out_shapes = [o.shape for o in exe.outputs]
+    head_grads = [nd.array(np.random.normal(0, 0.01, size=s).astype(np.float32)) for s in out_shapes]
+    exe.backward(head_grads)
+    analytic = {k: exe.grad_dict[k].asnumpy().copy() for k in grad_nodes}
+
+    def eval_sum(loc):
+        exe2 = _bind(sym, loc, aux_states, "null", ctx)
+        outs = exe2.forward(is_train=True)
+        return sum(float(np.sum(o.asnumpy() * g.asnumpy())) for o, g in zip(outs, head_grads))
+
+    for name in grad_nodes:
+        base_val = location[name]
+        numeric = np.zeros_like(base_val, dtype=np.float64)
+        flat = base_val.reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for i in range(flat.size):
+            old = flat[i]
+            flat[i] = old + numeric_eps
+            fplus = eval_sum(location)
+            flat[i] = old - numeric_eps
+            fminus = eval_sum(location)
+            flat[i] = old
+            num_flat[i] = (fplus - fminus) / (2 * numeric_eps)
+        a = analytic[name]
+        atol_eff = atol if atol is not None else 1e-3
+        np.testing.assert_allclose(
+            a, numeric.astype(a.dtype), rtol=rtol, atol=atol_eff,
+            err_msg="numeric gradient mismatch for %s" % name,
+        )
+
+
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write", rtol=1e-3, atol=1e-4):
+    """Run the same symbol on several contexts and compare outputs & grads
+    (reference test_utils.py:705) — cpu vs tpu backends here."""
+    shapes = ctx_list[0]["shapes"] if "shapes" in ctx_list[0] else None
+    results = []
+    for spec in ctx_list:
+        ctx = spec["ctx"]
+        shape_kwargs = {k: v for k, v in spec.items() if k != "ctx"}
+        arg_shapes, _, aux_shapes = sym.infer_shape(**shape_kwargs)
+        rng = np.random.RandomState(0)
+        location = {
+            n: (rng.normal(0, scale, size=s)).astype(np.float32)
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+        }
+        exe = _bind(sym, location, None, grad_req, ctx)
+        exe.forward(is_train=True)
+        exe.backward([nd.array(np.ones(o.shape, np.float32), ctx=ctx) for o in exe.outputs])
+        results.append((
+            [o.asnumpy() for o in exe.outputs],
+            {k: v.asnumpy() for k, v in exe.grad_dict.items()},
+        ))
+    ref_outs, ref_grads = results[0]
+    for outs, grads in results[1:]:
+        for a, b in zip(ref_outs, outs):
+            np.testing.assert_allclose(a, b, rtol=rtol, atol=atol)
+        for k in ref_grads:
+            np.testing.assert_allclose(ref_grads[k], grads[k], rtol=rtol, atol=atol)
+    return results

@@ -1,0 +1,141 @@
+"""Network visualization (reference python/mxnet/visualization.py):
+print_summary table + graphviz plot_network."""
+from __future__ import annotations
+
+import json
+
+from .symbol import Symbol
+from .base import MXNetError
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
+    """Layer-by-layer summary with param counts (reference
+    visualization.py print_summary)."""
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be Symbol")
+    show_shape = False
+    shape_dict = {}
+    if shape is not None:
+        show_shape = True
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape)
+        for k, v in zip(symbol.list_arguments(), arg_shapes):
+            shape_dict[k] = v
+        internals = symbol.get_internals()
+        for k, v in zip(internals.list_outputs(),
+                        internals._infer(shape, partial=True)[1]):
+            shape_dict[k] = v
+
+    positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    lines = []
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[: positions[i]]
+            line += " " * (positions[i] - len(line))
+        lines.append(line)
+
+    lines.append("_" * line_length)
+    print_row(to_display, positions)
+    lines.append("=" * line_length)
+    total_params = [0]
+
+    def print_layer_summary(node, out_shape):
+        op = node["op"]
+        pre_node = []
+        if op != "null":
+            inputs = node["inputs"]
+            for item in inputs:
+                input_node = nodes[item[0]]
+                input_name = input_node["name"]
+                if input_node["op"] != "null" or item[0] in heads_set:
+                    pre_node.append(input_name)
+        cur_param = 0
+        if op == "null":
+            if node["name"].endswith("weight") or node["name"].endswith("bias") or \
+               node["name"].endswith("gamma") or node["name"].endswith("beta"):
+                if show_shape and node["name"] in shape_dict:
+                    cur_param = 1
+                    for d in shape_dict[node["name"]]:
+                        cur_param *= d
+        first_connection = pre_node[0] if pre_node else ""
+        fields = [
+            node["name"] + " (" + op + ")",
+            str(out_shape) if show_shape else "",
+            cur_param,
+            first_connection,
+        ]
+        print_row(fields, positions)
+        for i in range(1, len(pre_node)):
+            fields = ["", "", "", pre_node[i]]
+            print_row(fields, positions)
+        total_params[0] += cur_param
+
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    heads_set = set(h[0] for h in conf["heads"])
+    for node in nodes:
+        out_shape = None
+        if show_shape:
+            key = node["name"] + "_output" if node["op"] != "null" else node["name"]
+            if key in shape_dict:
+                out_shape = shape_dict[key]
+        print_layer_summary(node, out_shape)
+        lines.append("_" * line_length)
+    lines.append("Total params: %d" % total_params[0])
+    lines.append("_" * line_length)
+    out = "\n".join(lines)
+    print(out)
+    return out
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs={}, hide_weights=True):
+    """Graphviz network plot (reference visualization.py plot_network).
+    Returns a graphviz.Digraph; rendering requires the graphviz binary."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("plot_network requires the graphviz python package")
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be a Symbol")
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    node_attr.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+    fill_colors = ["#8dd3c7", "#fb8072", "#ffffb3", "#bebada", "#80b1d3",
+                   "#fdb462", "#b3de69", "#fccde5"]
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            if hide_weights and (name.endswith("_weight") or name.endswith("_bias")
+                                 or name.endswith("_gamma") or name.endswith("_beta")
+                                 or name.endswith("_moving_mean")
+                                 or name.endswith("_moving_var")):
+                continue
+            attr = dict(node_attr)
+            attr["fillcolor"] = fill_colors[0]
+            dot.node(name=name, label=name, **attr)
+        else:
+            attr = dict(node_attr)
+            attr["fillcolor"] = fill_colors[hash(op) % len(fill_colors)]
+            dot.node(name=name, label="%s\n%s" % (op, name), **attr)
+    name_set = set(n["name"] for n in nodes if not (
+        n["op"] == "null" and hide_weights and (
+            n["name"].endswith("_weight") or n["name"].endswith("_bias")
+            or n["name"].endswith("_gamma") or n["name"].endswith("_beta")
+            or n["name"].endswith("_moving_mean") or n["name"].endswith("_moving_var"))))
+    for node in nodes:
+        if node["op"] == "null":
+            continue
+        for item in node["inputs"]:
+            src = nodes[item[0]]["name"]
+            if src in name_set:
+                dot.edge(tail_name=src, head_name=node["name"])
+    return dot

@@ -1,0 +1,13 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax backend
+init — the analogue of the reference's multi-device-without-hardware trick
+(tests/python/unittest/test_multi_device_exec.py binds cpu(0..N), SURVEY §4.3).
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,94 @@
+"""Imperative autograd tests (analogue of reference test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu import ndarray as nd
+
+
+def test_simple_grad():
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(), rtol=1e-5)
+
+
+def test_chain():
+    x = nd.array(np.random.rand(3, 4).astype(np.float32))
+    x.attach_grad()
+    with ag.record():
+        y = nd.exp(x)
+        z = nd.sum(y)
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.exp(x.asnumpy()), rtol=1e-5)
+
+
+def test_two_variables():
+    a = nd.array(np.random.rand(3).astype(np.float32))
+    b = nd.array(np.random.rand(3).astype(np.float32))
+    ag.mark_variables([a, b], [nd.zeros(3), nd.zeros(3)])
+    with ag.record():
+        c = a * b + a
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), b.asnumpy() + 1, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.asnumpy(), a.asnumpy(), rtol=1e-5)
+
+
+def test_grad_add_req():
+    x = nd.array(np.ones(3, np.float32))
+    grad = nd.zeros(3)
+    ag.mark_variables([x], [grad], "add")
+    for _ in range(2):
+        with ag.record():
+            y = x * 3.0
+        y.backward()
+    np.testing.assert_allclose(grad.asnumpy(), np.full(3, 6.0), rtol=1e-5)
+
+
+def test_matmul_grad():
+    a_np = np.random.rand(3, 4).astype(np.float32)
+    b_np = np.random.rand(4, 2).astype(np.float32)
+    a, b = nd.array(a_np), nd.array(b_np)
+    ag.mark_variables([a, b], [nd.zeros(a.shape), nd.zeros(b.shape)])
+    with ag.record():
+        c = nd.dot(a, b)
+        s = nd.sum(c)
+    s.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), np.ones((3, 2)) @ b_np.T, rtol=1e-4)
+    np.testing.assert_allclose(b.grad.asnumpy(), a_np.T @ np.ones((3, 2)), rtol=1e-4)
+
+
+def test_training_flag():
+    x = nd.ones((10, 10))
+    with ag.record(train_mode=True):
+        assert ag.is_training()
+        assert ag.is_recording()
+        y = nd.Dropout(x, p=0.5)
+    assert (y.asnumpy() == 0).any()
+    with ag.record(train_mode=False):
+        y = nd.Dropout(x, p=0.5)
+    np.testing.assert_array_equal(y.asnumpy(), x.asnumpy())
+    assert not ag.is_recording()
+
+
+def test_head_grads():
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    x.attach_grad()
+    with ag.record():
+        y = x * 4.0
+    y.backward(nd.array(np.array([2.0, 3.0], np.float32)))
+    np.testing.assert_allclose(x.grad.asnumpy(), [8.0, 12.0], rtol=1e-5)
+
+
+def test_repeated_backward_recompiles_not():
+    # steady-state imperative loop: same tape structure → cached executable
+    x = nd.array(np.ones(4, np.float32))
+    x.attach_grad()
+    for i in range(5):
+        with ag.record():
+            y = nd.sum(x * float(1.0))
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.ones(4), rtol=1e-6)

@@ -1,0 +1,124 @@
+"""Executor bind/forward/backward tests (analogue of reference
+test_executor.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+
+
+def test_bind_forward():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b
+    ctx = mx.cpu()
+    a_nd = nd.array(np.random.rand(3, 4).astype(np.float32))
+    b_nd = nd.array(np.random.rand(3, 4).astype(np.float32))
+    exe = c.bind(ctx, {"a": a_nd, "b": b_nd})
+    outs = exe.forward()
+    np.testing.assert_allclose(outs[0].asnumpy(), a_nd.asnumpy() + b_nd.asnumpy(), rtol=1e-6)
+
+
+def test_backward_simple():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a * b
+    a_np = np.random.rand(3, 4).astype(np.float32)
+    b_np = np.random.rand(3, 4).astype(np.float32)
+    a_nd, b_nd = nd.array(a_np), nd.array(b_np)
+    grads = {"a": nd.zeros((3, 4)), "b": nd.zeros((3, 4))}
+    exe = c.bind(mx.cpu(), {"a": a_nd, "b": b_nd}, args_grad=grads)
+    exe.forward(is_train=True)
+    exe.backward([nd.ones((3, 4))])
+    np.testing.assert_allclose(grads["a"].asnumpy(), b_np, rtol=1e-5)
+    np.testing.assert_allclose(grads["b"].asnumpy(), a_np, rtol=1e-5)
+
+
+def test_grad_req_add():
+    a = sym.Variable("a")
+    c = a * 2.0
+    a_nd = nd.array(np.ones((2, 2), np.float32))
+    grads = {"a": nd.zeros((2, 2))}
+    exe = c.bind(mx.cpu(), {"a": a_nd}, args_grad=grads, grad_req="add")
+    for _ in range(3):
+        exe.forward(is_train=True)
+        exe.backward([nd.ones((2, 2))])
+    np.testing.assert_allclose(grads["a"].asnumpy(), np.full((2, 2), 6.0), rtol=1e-5)
+
+
+def test_simple_bind():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=8, name="fc")
+    out = sym.SoftmaxOutput(fc, name="softmax")
+    exe = out.simple_bind(mx.cpu(), data=(4, 10))
+    assert exe.arg_dict["fc_weight"].shape == (8, 10)
+    assert exe.arg_dict["softmax_label"].shape == (4,)
+    exe.arg_dict["data"][:] = 1.0
+    outs = exe.forward(is_train=False)
+    assert outs[0].shape == (4, 8)
+    np.testing.assert_allclose(outs[0].asnumpy().sum(axis=1), np.ones(4), rtol=1e-5)
+
+
+def test_softmax_output_backward():
+    data = sym.Variable("data")
+    out = sym.SoftmaxOutput(data, name="softmax")
+    x = np.random.rand(4, 5).astype(np.float32)
+    label = np.array([0, 1, 2, 3], np.float32)
+    exe = out.simple_bind(mx.cpu(), data=(4, 5))
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["softmax_label"][:] = label
+    exe.forward(is_train=True)
+    exe.backward()
+    p = exe.outputs[0].asnumpy()
+    expected = p.copy()
+    expected[np.arange(4), label.astype(int)] -= 1.0
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_aux_update():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, name="bn", momentum=0.5)
+    exe = bn.simple_bind(mx.cpu(), data=(8, 3, 4, 4))
+    x = np.random.randn(8, 3, 4, 4).astype(np.float32)
+    exe.arg_dict["data"][:] = x
+    exe.aux_dict["bn_moving_var"][:] = 1.0
+    mean_before = exe.aux_dict["bn_moving_mean"].asnumpy().copy()
+    exe.forward(is_train=True)
+    mean_after = exe.aux_dict["bn_moving_mean"].asnumpy()
+    batch_mean = x.mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(mean_after, 0.5 * mean_before + 0.5 * batch_mean, rtol=1e-4)
+    # eval mode: uses moving stats, does not update them
+    exe.forward(is_train=False)
+    np.testing.assert_allclose(exe.aux_dict["bn_moving_mean"].asnumpy(), mean_after, rtol=1e-6)
+
+
+def test_dropout_train_vs_eval():
+    data = sym.Variable("data")
+    do = sym.Dropout(data, p=0.5, name="do")
+    exe = do.simple_bind(mx.cpu(), data=(100, 100), grad_req="null")
+    exe.arg_dict["data"][:] = 1.0
+    out_train = exe.forward(is_train=True)[0].asnumpy()
+    assert (out_train == 0).mean() > 0.3  # roughly half dropped
+    out_eval = exe.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_array_equal(out_eval, np.ones((100, 100), np.float32))
+
+
+def test_executor_reshape():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+    exe = fc.simple_bind(mx.cpu(), data=(8, 6))
+    exe2 = exe.reshape(data=(2, 6))
+    assert exe2.arg_dict["data"].shape == (2, 6)
+    # params shared
+    assert exe2.arg_dict["fc_weight"] is exe.arg_dict["fc_weight"]
+
+
+def test_monitor_callback():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+    exe = fc.simple_bind(mx.cpu(), data=(2, 3), grad_req="null")
+    seen = []
+    exe.set_monitor_callback(lambda name, arr: seen.append(name))
+    exe.forward(is_train=False)
+    assert any("fc" in s for s in seen)

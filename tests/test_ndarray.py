@@ -1,0 +1,158 @@
+"""NDArray imperative API tests (analogue of the reference's
+tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def test_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.asnumpy().sum() == 0
+    b = nd.ones((2, 3))
+    np.testing.assert_array_equal(b.asnumpy(), np.ones((2, 3), np.float32))
+    c = nd.full((2, 2), 7.0)
+    assert c.asnumpy()[0, 0] == 7.0
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.dtype == np.float32
+    e = nd.arange(0, 10, 2)
+    np.testing.assert_array_equal(e.asnumpy(), np.arange(0, 10, 2, dtype=np.float32))
+
+
+def test_arithmetic():
+    rng = np.random.RandomState(0)
+    a_np = rng.randn(3, 4).astype(np.float32)
+    b_np = rng.randn(3, 4).astype(np.float32)
+    a, b = nd.array(a_np), nd.array(b_np)
+    np.testing.assert_allclose((a + b).asnumpy(), a_np + b_np, rtol=1e-6)
+    np.testing.assert_allclose((a - b).asnumpy(), a_np - b_np, rtol=1e-6)
+    np.testing.assert_allclose((a * b).asnumpy(), a_np * b_np, rtol=1e-6)
+    np.testing.assert_allclose((a / b).asnumpy(), a_np / b_np, rtol=1e-5)
+    np.testing.assert_allclose((a + 2).asnumpy(), a_np + 2, rtol=1e-6)
+    np.testing.assert_allclose((2 - a).asnumpy(), 2 - a_np, rtol=1e-6)
+    np.testing.assert_allclose((a * 3).asnumpy(), a_np * 3, rtol=1e-6)
+    np.testing.assert_allclose((1 / (a + 10)).asnumpy(), 1 / (a_np + 10), rtol=1e-5)
+    np.testing.assert_allclose((-a).asnumpy(), -a_np, rtol=1e-6)
+    np.testing.assert_allclose(abs(a).asnumpy(), np.abs(a_np), rtol=1e-6)
+
+
+def test_inplace():
+    a = nd.ones((2, 3))
+    a += 1
+    np.testing.assert_array_equal(a.asnumpy(), np.full((2, 3), 2, np.float32))
+    a *= 3
+    np.testing.assert_array_equal(a.asnumpy(), np.full((2, 3), 6, np.float32))
+    a[:] = 0.5
+    np.testing.assert_array_equal(a.asnumpy(), np.full((2, 3), 0.5, np.float32))
+
+
+def test_indexing():
+    a_np = np.arange(24, dtype=np.float32).reshape(4, 6)
+    a = nd.array(a_np)
+    np.testing.assert_array_equal(a[1].asnumpy(), a_np[1])
+    np.testing.assert_array_equal(a[1:3].asnumpy(), a_np[1:3])
+    a[2] = 0
+    a_np[2] = 0
+    np.testing.assert_array_equal(a.asnumpy(), a_np)
+
+
+def test_dtype_cast():
+    a = nd.ones((2, 2), dtype="float32")
+    b = a.astype("int32")
+    assert b.asnumpy().dtype == np.int32
+    c = nd.Cast(a, dtype="float16")
+    assert c.asnumpy().dtype == np.float16
+
+
+def test_generated_ops():
+    a = nd.array([[1.0, 4.0], [9.0, 16.0]])
+    np.testing.assert_allclose(nd.sqrt(a).asnumpy(), np.sqrt(a.asnumpy()), rtol=1e-6)
+    np.testing.assert_allclose(nd.log(a).asnumpy(), np.log(a.asnumpy()), rtol=1e-6)
+    np.testing.assert_allclose(nd.square(a).asnumpy(), a.asnumpy() ** 2, rtol=1e-6)
+    s = nd.sum(a, axis=1)
+    np.testing.assert_allclose(s.asnumpy(), a.asnumpy().sum(axis=1), rtol=1e-6)
+
+
+def test_dot():
+    a = nd.array(np.random.rand(4, 5).astype(np.float32))
+    b = nd.array(np.random.rand(5, 3).astype(np.float32))
+    c = nd.dot(a, b)
+    np.testing.assert_allclose(c.asnumpy(), a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+    ct = nd.dot(a, b.T, transpose_b=True)
+    np.testing.assert_allclose(ct.asnumpy(), a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+
+
+def test_reshape_ops():
+    a = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    r = nd.Reshape(a, shape=(2, 12))
+    assert r.shape == (2, 12)
+    r2 = nd.Reshape(a, shape=(0, -1))
+    assert r2.shape == (2, 12)
+    f = nd.Flatten(a)
+    assert f.shape == (2, 12)
+    t = nd.transpose(a, axes=(2, 0, 1))
+    assert t.shape == (4, 2, 3)
+    e = nd.expand_dims(a, axis=1)
+    assert e.shape == (2, 1, 3, 4)
+
+
+def test_concat_split():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.Concat(a, b, dim=1, num_args=2)
+    assert c.shape == (2, 6)
+    parts = nd.SliceChannel(c, num_outputs=2, axis=1)
+    np.testing.assert_array_equal(parts[0].asnumpy(), a.asnumpy())
+    np.testing.assert_array_equal(parts[1].asnumpy(), b.asnumpy())
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrays")
+    d = {"w": nd.array(np.random.rand(3, 3).astype(np.float32)),
+         "b": nd.array(np.random.rand(3).astype(np.float32))}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    for k in d:
+        np.testing.assert_array_equal(loaded[k].asnumpy(), d[k].asnumpy())
+
+
+def test_broadcast():
+    a = nd.array(np.random.rand(3, 1).astype(np.float32))
+    b = nd.broadcast_to(a, shape=(3, 4))
+    assert b.shape == (3, 4)
+    c = nd.broadcast_axis(a, axis=1, size=5)
+    assert c.shape == (3, 5)
+
+
+def test_comparison():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    np.testing.assert_array_equal((a > b).asnumpy(), [0, 0, 1])
+    np.testing.assert_array_equal((a == 2).asnumpy(), [0, 1, 0])
+
+
+def test_ordering_ops():
+    a = nd.array(np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], np.float32))
+    s = nd.sort(a, axis=1)
+    np.testing.assert_array_equal(s.asnumpy(), np.sort(a.asnumpy(), axis=1))
+    k = nd.topk(a, k=2, ret_typ="value")
+    np.testing.assert_array_equal(k.asnumpy(), [[3.0, 2.0], [5.0, 4.0]])
+
+
+def test_wait_and_context():
+    a = nd.ones((4, 4))
+    a.wait_to_read()
+    assert a.context.device_type in ("cpu", "tpu")
+    nd.waitall()
+
+
+def test_take_onehot():
+    w = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = nd.array(np.array([0, 2], np.float32))
+    t = nd.take(w, idx)
+    np.testing.assert_array_equal(t.asnumpy(), w.asnumpy()[[0, 2]])
+    oh = nd.one_hot(idx, depth=4)
+    assert oh.shape == (2, 4)
+    assert oh.asnumpy()[1, 2] == 1.0

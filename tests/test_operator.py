@@ -1,0 +1,220 @@
+"""Operator correctness tests vs numpy + numeric gradient checks
+(analogue of the reference's tests/python/unittest/test_operator.py,
+using the ported check_numeric_gradient harness, test_utils.py:360)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import (
+    check_numeric_gradient, check_symbolic_forward, check_symbolic_backward,
+)
+
+
+def test_fully_connected_forward():
+    x = np.random.rand(4, 6).astype(np.float32)
+    w = np.random.rand(5, 6).astype(np.float32)
+    b = np.random.rand(5).astype(np.float32)
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=5, name="fc")
+    check_symbolic_forward(fc, {"data": x, "fc_weight": w, "fc_bias": b},
+                           [x @ w.T + b], rtol=1e-4)
+
+
+def test_fully_connected_grad():
+    x = np.random.rand(3, 4).astype(np.float32)
+    w = np.random.rand(2, 4).astype(np.float32)
+    b = np.random.rand(2).astype(np.float32)
+    fc = sym.FullyConnected(sym.Variable("data"), num_hidden=2, name="fc")
+    check_numeric_gradient(fc, {"data": x, "fc_weight": w, "fc_bias": b},
+                           numeric_eps=1e-2, rtol=0.05, atol=1e-2)
+
+
+def test_activation():
+    x = np.random.randn(3, 4).astype(np.float32)
+    for act, fn in [("relu", lambda v: np.maximum(v, 0)),
+                    ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+                    ("tanh", np.tanh),
+                    ("softrelu", lambda v: np.log1p(np.exp(v)))]:
+        s = sym.Activation(sym.Variable("data"), act_type=act)
+        check_symbolic_forward(s, {"data": x}, [fn(x)], rtol=1e-4, atol=1e-5)
+
+
+def test_elemwise_grad():
+    a = np.random.rand(3, 3).astype(np.float32) + 0.5
+    b = np.random.rand(3, 3).astype(np.float32) + 0.5
+    lhs, rhs = sym.Variable("lhs"), sym.Variable("rhs")
+    check_numeric_gradient(lhs * rhs + lhs / rhs, {"lhs": a, "rhs": b},
+                           numeric_eps=1e-3, rtol=0.05, atol=1e-2)
+
+
+def test_convolution_forward():
+    x = np.random.rand(2, 3, 8, 8).astype(np.float32)
+    conv = sym.Convolution(sym.Variable("data"), kernel=(3, 3), num_filter=4,
+                           pad=(1, 1), name="conv")
+    arg_shapes, out_shapes, _ = conv.infer_shape(data=x.shape)
+    assert out_shapes[0] == (2, 4, 8, 8)
+    # numeric check against scipy-style direct conv for one output position
+    w = np.random.rand(4, 3, 3, 3).astype(np.float32)
+    b = np.zeros(4, np.float32)
+    out = check_symbolic_forward.__wrapped__ if False else None
+    from mxnet_tpu.test_utils import _bind
+
+    exe = _bind(conv, {"data": x, "conv_weight": w, "conv_bias": b}, grad_req="null")
+    res = exe.forward()[0].asnumpy()
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    manual = np.einsum("nchw,fchw->nf", xp[:, :, 3:6, 3:6], w)
+    np.testing.assert_allclose(res[:, :, 3, 3], manual, rtol=1e-3, atol=1e-4)
+
+
+def test_convolution_grad():
+    x = np.random.rand(2, 2, 5, 5).astype(np.float32)
+    conv = sym.Convolution(sym.Variable("data"), kernel=(3, 3), num_filter=2, name="conv")
+    w = np.random.rand(2, 2, 3, 3).astype(np.float32)
+    b = np.random.rand(2).astype(np.float32)
+    check_numeric_gradient(conv, {"data": x, "conv_weight": w, "conv_bias": b},
+                           numeric_eps=1e-2, rtol=0.1, atol=2e-2)
+
+
+def test_pooling():
+    x = np.random.rand(1, 1, 4, 4).astype(np.float32)
+    pool = sym.Pooling(sym.Variable("data"), kernel=(2, 2), stride=(2, 2), pool_type="max")
+    expected = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+    check_symbolic_forward(pool, {"data": x}, [expected], rtol=1e-5)
+    pool_avg = sym.Pooling(sym.Variable("data"), kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    expected_avg = x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+    check_symbolic_forward(pool_avg, {"data": x}, [expected_avg], rtol=1e-5)
+
+
+def test_deconvolution_shape():
+    x = np.random.rand(1, 4, 5, 5).astype(np.float32)
+    deconv = sym.Deconvolution(sym.Variable("data"), kernel=(4, 4), stride=(2, 2),
+                               pad=(1, 1), num_filter=3, name="dc")
+    arg_shapes, out_shapes, _ = deconv.infer_shape(data=x.shape)
+    assert out_shapes[0] == (1, 3, 10, 10)
+    shapes = dict(zip(deconv.list_arguments(), arg_shapes))
+    assert shapes["dc_weight"] == (4, 3, 4, 4)
+
+
+def test_batchnorm_forward():
+    x = np.random.randn(4, 3, 2, 2).astype(np.float32)
+    bn = sym.BatchNorm(sym.Variable("data"), fix_gamma=False, name="bn")
+    gamma = np.random.rand(3).astype(np.float32) + 0.5
+    beta = np.random.rand(3).astype(np.float32)
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    expected = ((x - mean.reshape(1, 3, 1, 1)) / np.sqrt(var.reshape(1, 3, 1, 1) + 1e-3)
+                * gamma.reshape(1, 3, 1, 1) + beta.reshape(1, 3, 1, 1))
+    from mxnet_tpu.test_utils import _bind
+
+    exe = _bind(bn, {"data": x, "bn_gamma": gamma, "bn_beta": beta}, grad_req="null")
+    out = exe.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_embedding():
+    idx = np.array([[0, 2], [1, 3]], np.float32)
+    w = np.random.rand(4, 5).astype(np.float32)
+    emb = sym.Embedding(sym.Variable("data"), input_dim=4, output_dim=5, name="emb")
+    check_symbolic_forward(emb, {"data": idx, "emb_weight": w}, [w[idx.astype(int)]],
+                           rtol=1e-5)
+
+
+def test_transpose_reshape_grad():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    s = sym.transpose(sym.Variable("data"), axes=(1, 0, 2))
+    check_numeric_gradient(s, {"data": x}, numeric_eps=1e-2, rtol=0.05, atol=1e-2)
+
+
+def test_broadcast_ops():
+    a = np.random.rand(3, 1).astype(np.float32)
+    b = np.random.rand(1, 4).astype(np.float32)
+    s = sym.broadcast_add(sym.Variable("lhs"), sym.Variable("rhs"))
+    check_symbolic_forward(s, {"lhs": a, "rhs": b}, [a + b], rtol=1e-5)
+    check_numeric_gradient(s, {"lhs": a, "rhs": b}, numeric_eps=1e-2, rtol=0.05, atol=1e-2)
+
+
+def test_reduce_ops():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    for name, np_fn in [("sum", np.sum), ("mean", np.mean), ("max", np.max), ("min", np.min)]:
+        s = getattr(sym, name)(sym.Variable("data"), axis=1)
+        check_symbolic_forward(s, {"data": x}, [np_fn(x, axis=1)], rtol=1e-4, atol=1e-5)
+
+
+def test_leaky_relu():
+    x = np.random.randn(3, 4).astype(np.float32)
+    s = sym.LeakyReLU(sym.Variable("data"), act_type="leaky", slope=0.1)
+    expected = np.where(x > 0, x, 0.1 * x)
+    check_symbolic_forward(s, {"data": x}, [expected], rtol=1e-5)
+
+
+def test_sequence_ops():
+    x = np.random.rand(4, 2, 3).astype(np.float32)  # (T, N, C)
+    lengths = np.array([2, 4], np.float32)
+    s = sym.SequenceMask(sym.Variable("data"), sym.Variable("len"),
+                         use_sequence_length=True, value=0.0)
+    expected = x.copy()
+    expected[2:, 0] = 0
+    check_symbolic_forward(s, {"data": x, "len": lengths}, [expected], rtol=1e-5)
+    s_last = sym.SequenceLast(sym.Variable("data"), sym.Variable("len"),
+                              use_sequence_length=True)
+    expected_last = np.stack([x[1, 0], x[3, 1]])
+    check_symbolic_forward(s_last, {"data": x, "len": lengths}, [expected_last], rtol=1e-5)
+
+
+def test_where():
+    cond = np.array([[1, 0], [0, 1]], np.float32)
+    a = np.ones((2, 2), np.float32)
+    b = np.zeros((2, 2), np.float32)
+    s = sym.where(sym.Variable("condition"), sym.Variable("x"), sym.Variable("y"))
+    check_symbolic_forward(s, {"condition": cond, "x": a, "y": b}, [cond], rtol=1e-6)
+
+
+def test_optimizer_ops_vs_numpy():
+    w = np.random.rand(5).astype(np.float32)
+    g = np.random.rand(5).astype(np.float32)
+    out = nd.sgd_update(nd.array(w), nd.array(g), lr=0.1, wd=0.01, rescale_grad=1.0)
+    expected = w - 0.1 * (g + 0.01 * w)
+    np.testing.assert_allclose(out.asnumpy(), expected, rtol=1e-5)
+
+    mom = np.zeros(5, np.float32)
+    res = nd.sgd_mom_update(nd.array(w), nd.array(g), nd.array(mom),
+                            lr=0.1, momentum=0.9, rescale_grad=1.0)
+    np.testing.assert_allclose(res[0].asnumpy(), w - 0.1 * g, rtol=1e-5)
+
+    mean = np.zeros(5, np.float32)
+    var = np.zeros(5, np.float32)
+    res = nd.adam_update(nd.array(w), nd.array(g), nd.array(mean), nd.array(var),
+                         lr=0.01, rescale_grad=1.0)
+    m_t = 0.1 * g
+    v_t = 0.001 * g * g
+    expected = w - 0.01 * m_t / (np.sqrt(v_t) + 1e-8)
+    np.testing.assert_allclose(res[0].asnumpy(), expected, rtol=1e-4)
+
+
+def test_lrn():
+    x = np.random.rand(2, 5, 3, 3).astype(np.float32)
+    s = sym.LRN(sym.Variable("data"), nsize=3, alpha=1e-4, beta=0.75, knorm=2.0)
+    exe_out = check_symbolic_forward.__doc__ and None
+    from mxnet_tpu.test_utils import _bind
+
+    exe = _bind(s, {"data": x}, grad_req="null")
+    out = exe.forward()[0].asnumpy()
+    # manual reference
+    sq = x ** 2
+    acc = np.zeros_like(x)
+    for c in range(5):
+        lo, hi = max(0, c - 1), min(5, c + 2)
+        acc[:, c] = sq[:, lo:hi].sum(axis=1)
+    expected = x / (2.0 + 1e-4 / 3 * acc) ** 0.75
+    np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_clip_smooth_l1():
+    x = np.array([-2.0, -0.5, 0.5, 2.0], np.float32)
+    np.testing.assert_allclose(nd.clip(nd.array(x), a_min=-1, a_max=1).asnumpy(),
+                               np.clip(x, -1, 1))
+    sl = nd.smooth_l1(nd.array(x), scalar=1.0).asnumpy()
+    expected = np.where(np.abs(x) < 1, 0.5 * x ** 2, np.abs(x) - 0.5)
+    np.testing.assert_allclose(sl, expected, rtol=1e-5)

@@ -1,0 +1,110 @@
+"""Symbol composition / inference tests (analogue of reference
+test_symbol.py + test_infer_shape.py)."""
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data=data, num_hidden=128, name="fc1")
+    act1 = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act1, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_compose_and_arguments():
+    net = _mlp()
+    args = net.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+                    "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(32, 784))
+    shapes = dict(zip(net.list_arguments(), arg_shapes))
+    assert shapes["fc1_weight"] == (128, 784)
+    assert shapes["fc1_bias"] == (128,)
+    assert shapes["fc2_weight"] == (10, 128)
+    assert out_shapes[0] == (32, 10)
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, kernel=(3, 3), num_filter=16, pad=(1, 1), name="conv")
+    bn = sym.BatchNorm(conv, name="bn")
+    pool = sym.Pooling(bn, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, aux_shapes = pool.infer_shape(data=(4, 3, 32, 32))
+    shapes = dict(zip(pool.list_arguments(), arg_shapes))
+    assert shapes["conv_weight"] == (16, 3, 3, 3)
+    assert shapes["conv_bias"] == (16,)
+    assert shapes["bn_gamma"] == (16,)
+    assert out_shapes[0] == (4, 16, 16, 16)
+    aux = dict(zip(pool.list_auxiliary_states(), aux_shapes))
+    assert aux["bn_moving_mean"] == (16,)
+    assert aux["bn_moving_var"] == (16,)
+
+
+def test_symbol_arithmetic():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b * 2.0
+    _, out_shapes, _ = c.infer_shape(a=(3, 4), b=(3, 4))
+    assert out_shapes[0] == (3, 4)
+
+
+def test_group_and_getitem():
+    a = sym.Variable("a")
+    fc = sym.FullyConnected(a, num_hidden=5, name="fc")
+    g = sym.Group([fc, a])
+    assert len(g.list_outputs()) == 2
+    assert g[0].list_outputs() == ["fc_output"]
+
+
+def test_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    outs = internals.list_outputs()
+    assert "fc1_output" in outs
+    fc1 = internals["fc1_output"]
+    assert fc1.list_outputs() == ["fc1_output"]
+
+
+def test_save_load_json(tmp_path):
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    fname = str(tmp_path / "net.json")
+    net.save(fname)
+    net3 = sym.load(fname)
+    assert net3.list_arguments() == net.list_arguments()
+
+
+def test_name_manager():
+    with mx.NameManager():
+        f1 = sym.FullyConnected(sym.Variable("x"), num_hidden=3)
+        f2 = sym.FullyConnected(sym.Variable("y"), num_hidden=3)
+    assert f1.name != f2.name
+
+
+def test_attr_scope():
+    with mx.AttrScope(ctx_group="dev1"):
+        v = sym.Variable("w")
+    assert v.attr("ctx_group") == "dev1"
+
+
+def test_variable_shape_hint():
+    v = sym.Variable("x", shape=(4, 5))
+    f = sym.sum(v)
+    arg_shapes, out_shapes, _ = f.infer_shape()
+    assert arg_shapes[0] == (4, 5)
+    assert out_shapes[0] == ()
