@@ -1,0 +1,45 @@
+"""Model zoo — symbolic network definitions with capability parity to the
+reference's example/image-classification/symbols/ + example/rnn/.
+
+Each builder returns a Symbol whose head is a SoftmaxOutput named
+``softmax`` so every model drops into ``Module.fit`` / ``FeedForward``
+unchanged (reference example/image-classification/train_model.py pattern).
+
+Factory: ``get_symbol(name, num_classes=..., **kwargs)``.
+"""
+from . import mlp
+from . import lenet
+from . import alexnet
+from . import vgg
+from . import inception_bn
+from . import inception_v3
+from . import resnet
+from . import lstm_lm
+from . import transformer
+
+_BUILDERS = {
+    "mlp": mlp.get_symbol,
+    "lenet": lenet.get_symbol,
+    "alexnet": alexnet.get_symbol,
+    "vgg": vgg.get_symbol,
+    "vgg16": lambda **kw: vgg.get_symbol(num_layers=16, **kw),
+    "vgg19": lambda **kw: vgg.get_symbol(num_layers=19, **kw),
+    "inception-bn": inception_bn.get_symbol,
+    "inception-v3": inception_v3.get_symbol,
+    "resnet": resnet.get_symbol,
+    "resnet-18": lambda **kw: resnet.get_symbol(num_layers=18, **kw),
+    "resnet-34": lambda **kw: resnet.get_symbol(num_layers=34, **kw),
+    "resnet-50": lambda **kw: resnet.get_symbol(num_layers=50, **kw),
+    "resnet-101": lambda **kw: resnet.get_symbol(num_layers=101, **kw),
+    "resnet-152": lambda **kw: resnet.get_symbol(num_layers=152, **kw),
+    "lstm-lm": lstm_lm.get_symbol,
+    "transformer-lm": transformer.get_symbol,
+}
+
+
+def get_symbol(name, **kwargs):
+    key = name.lower()
+    if key not in _BUILDERS:
+        raise ValueError(
+            "unknown model %r; available: %s" % (name, sorted(_BUILDERS)))
+    return _BUILDERS[key](**kwargs)

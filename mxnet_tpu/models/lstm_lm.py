@@ -1,0 +1,36 @@
+"""LSTM language model (PTB) — BASELINE config 3, reference
+example/rnn/lstm_bucketing.py. Embedding → stacked LSTM (unrolled) →
+per-step FC → softmax over the flattened (batch*time) axis.
+
+On TPU the unrolled graph compiles to ONE XLA computation; for long
+sequences prefer FusedRNNCell, whose scan-based kernel is the cuDNN-RNN
+analogue (SURVEY §5.7).
+"""
+from .. import symbol as sym
+from ..rnn import rnn_cell
+
+
+def get_symbol(num_classes=10000, seq_len=35, num_embed=200, num_hidden=200,
+               num_layers=2, dropout=0.0, fused=False, **kwargs):
+    data = sym.Variable('data')          # (batch, seq_len) int ids
+    embed = sym.Embedding(data=data, input_dim=num_classes,
+                          output_dim=num_embed, name='embed')
+
+    if fused:
+        stack = rnn_cell.FusedRNNCell(num_hidden, num_layers=num_layers,
+                                      mode='lstm', dropout=dropout,
+                                      prefix='lstm_')
+    else:
+        stack = rnn_cell.SequentialRNNCell()
+        for i in range(num_layers):
+            stack.add(rnn_cell.LSTMCell(num_hidden, prefix='lstm_l%d_' % i))
+            if dropout > 0 and i < num_layers - 1:
+                stack.add(rnn_cell.DropoutCell(dropout, prefix='drop_l%d_' % i))
+
+    outputs, _ = stack.unroll(seq_len, inputs=embed, merge_outputs=True,
+                              layout='NTC')
+    pred = sym.Reshape(data=outputs, shape=(-1, num_hidden))
+    pred = sym.FullyConnected(data=pred, num_hidden=num_classes, name='pred')
+    label = sym.Variable('softmax_label')
+    label = sym.Reshape(data=label, shape=(-1,))
+    return sym.SoftmaxOutput(data=pred, label=label, name='softmax')

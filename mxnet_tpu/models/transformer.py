@@ -1,0 +1,53 @@
+"""Decoder-only transformer LM on the Symbol API.
+
+The framework's modern long-sequence model (SURVEY §5.7: the idiomatic
+replacement for unrolled RNNs). Attention lowers to the Pallas flash kernel
+on TPU (ops/attention.py → ops/pallas/flash_attention.py); the sharded
+functional twin used for tp/pp/sp training lives in
+mxnet_tpu.parallel.transformer.
+"""
+from .. import symbol as sym
+
+
+def _block(x, num_heads, dm, dff, name):
+    ln1_g = sym.Variable(name + '_ln1_gamma', shape=(dm,))
+    ln1_b = sym.Variable(name + '_ln1_beta', shape=(dm,))
+    h = sym.LayerNorm(data=x, gamma=ln1_g, beta=ln1_b, name=name + '_ln1')
+    q = sym.FullyConnected(data=h, num_hidden=dm, flatten=False, no_bias=True,
+                           name=name + '_q')
+    k = sym.FullyConnected(data=h, num_hidden=dm, flatten=False, no_bias=True,
+                           name=name + '_k')
+    v = sym.FullyConnected(data=h, num_hidden=dm, flatten=False, no_bias=True,
+                           name=name + '_v')
+    att = sym.MultiHeadAttention(query=q, key=k, value=v, num_heads=num_heads,
+                                 causal=True, use_rope=True,
+                                 name=name + '_attn')
+    att = sym.FullyConnected(data=att, num_hidden=dm, flatten=False,
+                             no_bias=True, name=name + '_o')
+    x = x + att
+    ln2_g = sym.Variable(name + '_ln2_gamma', shape=(dm,))
+    ln2_b = sym.Variable(name + '_ln2_beta', shape=(dm,))
+    h = sym.LayerNorm(data=x, gamma=ln2_g, beta=ln2_b, name=name + '_ln2')
+    h = sym.FullyConnected(data=h, num_hidden=dff, flatten=False,
+                           name=name + '_ffn1')
+    h = sym.Activation(data=h, act_type='gelu', name=name + '_gelu')
+    h = sym.FullyConnected(data=h, num_hidden=dm, flatten=False,
+                           name=name + '_ffn2')
+    return x + h
+
+
+def get_symbol(num_classes=32000, seq_len=512, num_layers=4, num_heads=8,
+               model_dim=512, ffn_dim=2048, **kwargs):
+    data = sym.Variable('data')          # (batch, seq_len) int ids
+    x = sym.Embedding(data=data, input_dim=num_classes,
+                      output_dim=model_dim, name='embed')
+    for i in range(num_layers):
+        x = _block(x, num_heads, model_dim, ffn_dim, 'layer%d' % i)
+    lnf_g = sym.Variable('lnf_gamma', shape=(model_dim,))
+    lnf_b = sym.Variable('lnf_beta', shape=(model_dim,))
+    x = sym.LayerNorm(data=x, gamma=lnf_g, beta=lnf_b, name='lnf')
+    pred = sym.Reshape(data=x, shape=(-1, model_dim))
+    pred = sym.FullyConnected(data=pred, num_hidden=num_classes, name='pred')
+    label = sym.Variable('softmax_label')
+    label = sym.Reshape(data=label, shape=(-1,))
+    return sym.SoftmaxOutput(data=pred, label=label, name='softmax')

@@ -18,3 +18,5 @@ from . import optimizer_ops  # noqa: F401
 from . import sequence  # noqa: F401
 from . import shape_rules  # noqa: F401
 from . import rnn_fused  # noqa: F401
+from . import attention  # noqa: F401
+from . import contrib  # noqa: F401

@@ -1,0 +1,119 @@
+"""Attention & modern-normalization operators.
+
+These extend the reference's op set (which predates attention) to cover the
+long-context capability goal (SURVEY §5.7): the framework's idiomatic
+replacement for unrolled-RNN sequence handling is transformer attention,
+sharded over the mesh by the parallel layer (ring attention /
+sequence parallelism in mxnet_tpu.parallel).
+
+``MultiHeadAttention`` is the fusion seam: the default impl is XLA-fused
+jnp einsum math; when running on TPU with suitable shapes the executor can
+swap in the Pallas flash-attention kernel (ops/pallas/flash_attention.py) —
+the same layering as the reference's cuDNN fast paths over mshadow
+reference impls (src/operator/cudnn_*.h, SURVEY §2.1 #16).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import defop
+
+
+@defop(
+    "LayerNorm",
+    arg_names=("data", "gamma", "beta"),
+    param_spec={"axis": -1, "eps": 1e-5},
+)
+def _layer_norm(attrs, data, gamma, beta):
+    """Layer normalization over ``axis`` (modern analogue of the reference's
+    InstanceNorm/L2Normalization family, src/operator/instance_norm-inl.h)."""
+    ax = int(attrs["axis"]) % data.ndim
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.var(data, axis=ax, keepdims=True)
+    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    out = (data - mean) * jax.lax.rsqrt(var + attrs["eps"])
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@defop(
+    "RMSNorm",
+    arg_names=("data", "gamma"),
+    param_spec={"axis": -1, "eps": 1e-6},
+)
+def _rms_norm(attrs, data, gamma):
+    """Root-mean-square norm (no centering) — the bandwidth-cheaper norm
+    preferred on TPU (one fewer HBM pass than LayerNorm)."""
+    ax = int(attrs["axis"]) % data.ndim
+    ms = jnp.mean(jnp.square(data), axis=ax, keepdims=True)
+    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    return data * jax.lax.rsqrt(ms + attrs["eps"]) * gamma.reshape(bshape)
+
+
+def rope(x, positions=None, base=10000.0):
+    """Rotary position embedding over the last axis of (..., T, D)."""
+    d = x.shape[-1]
+    half = d // 2
+    if positions is None:
+        positions = jnp.arange(x.shape[-2])
+    freq = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., :, None].astype(jnp.float32) * freq  # (T, half)
+    sin, cos = jnp.sin(angles), jnp.cos(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    sin = sin.astype(x.dtype)
+    cos = cos.astype(x.dtype)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def dot_product_attention(q, k, v, causal=False, scale=None, mask=None):
+    """Reference attention math on (B, H, T, D) tensors.
+
+    Computed in float32 accumulation regardless of input dtype (MXU-friendly:
+    bf16 inputs, f32 softmax), matching flash-kernel numerics.
+    """
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        idx_q = jnp.arange(tq)[:, None] + (tk - tq)  # support kv longer than q
+        cmask = idx_q >= jnp.arange(tk)[None, :]
+        logits = jnp.where(cmask, logits, jnp.finfo(jnp.float32).min)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+@defop(
+    "MultiHeadAttention",
+    arg_names=("query", "key", "value"),
+    param_spec={"num_heads": 1, "causal": False, "use_rope": False,
+                "use_flash": True},
+)
+def _multi_head_attention(attrs, query, key, value):
+    """Fused multi-head attention on (B, T, H*D) projected inputs.
+
+    Splits heads, optionally applies RoPE, runs (flash) attention, and
+    merges heads. Projections (in/out) live outside this op as
+    FullyConnected so tensor-parallel sharding of the head axis is a pure
+    data layout (mxnet_tpu.parallel.tensor_parallel).
+    """
+    h = int(attrs["num_heads"])
+    b, tq, dm = query.shape
+    tk = key.shape[1]
+    d = dm // h
+
+    def split(x, t):
+        return x.reshape(b, t, h, d).transpose(0, 2, 1, 3)
+
+    q, k, v = split(query, tq), split(key, tk), split(value, tk)
+    if attrs["use_rope"]:
+        q, k = rope(q), rope(k)
+    if attrs["use_flash"]:
+        from .pallas import flash_attention as _fa
+        out = _fa.flash_attention(q, k, v, causal=bool(attrs["causal"]))
+    else:
+        out = dot_product_attention(q, k, v, causal=bool(attrs["causal"]))
+    return out.transpose(0, 2, 1, 3).reshape(b, tq, dm)

@@ -1,0 +1,410 @@
+"""Contrib operators (reference src/operator/contrib/, SURVEY §2.1 #19):
+CTCLoss, fft/ifft, count_sketch, quantize/dequantize, and the SSD /
+Faster-RCNN detection ops (MultiBoxPrior/Target/Detection, Proposal).
+
+TPU-first notes: the detection ops' control-flow-heavy matching/NMS is
+expressed as fixed-iteration masked computation (lax.fori_loop + where)
+instead of the reference's data-dependent CUDA loops, so everything stays
+jittable with static shapes (SURVEY §7 risk register "Detection ops").
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import defop
+
+_NEG = -1e9
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (reference contrib/ctc_loss.cc, vendored warp-ctc)
+# ---------------------------------------------------------------------------
+@defop(
+    "ctc_loss",
+    arg_names=("data", "label"),
+    param_spec={},
+    no_grad_inputs=("label",),
+    py_name="ctc_loss",
+)
+def _ctc_loss(attrs, data, label):
+    """Connectionist temporal classification loss.
+
+    data: (seq_len, batch, alphabet_size) activations (pre-softmax);
+    label: (batch, label_len) int labels, 0 = blank-padding (reference uses
+    0-padded labels with blank=0 at alphabet index 0? — the reference
+    warp-ctc convention is blank=0 and labels in 1..alphabet-1).
+    Returns per-example negative log likelihood, shape (batch,).
+    Gradient flows via jax autodiff of the log-space forward recursion —
+    equivalent to warp-ctc's alpha-beta gradient.
+    """
+    t_len, batch, nalpha = data.shape
+    logp = jax.nn.log_softmax(data, axis=-1)  # (T, B, A)
+    lab = label.astype(jnp.int32)             # (B, L), 0-padded
+    llen = jnp.sum((lab > 0).astype(jnp.int32), axis=1)  # (B,)
+    lmax = lab.shape[1]
+    s = 2 * lmax + 1
+    # extended label sequence: blank, l1, blank, l2, ... blank
+    ext = jnp.zeros((batch, s), jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    # positions beyond 2*llen are invalid
+    pos = jnp.arange(s)[None, :]
+    valid = pos < (2 * llen + 1)[:, None]
+
+    # can-skip: ext[i] != blank and ext[i] != ext[i-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)))[:, :s]
+    can_skip = (ext[:, :] != 0) & (ext != ext_m2) & (pos >= 2)
+
+    alpha0 = jnp.full((batch, s), _NEG)
+    alpha0 = alpha0.at[:, 0].set(logp[0, :, 0])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(llen > 0, jnp.take_along_axis(
+            logp[0], ext[:, 1:2], axis=1)[:, 0], _NEG))
+
+    def step(alpha, lp_t):
+        # lp_t: (B, A); gather per extended symbol: (B, S)
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)
+        a_m1 = jnp.pad(alpha, ((0, 0), (1, 0)), constant_values=_NEG)[:, :s]
+        a_m2 = jnp.pad(alpha, ((0, 0), (2, 0)), constant_values=_NEG)[:, :s]
+        stay = jnp.logaddexp(alpha, a_m1)
+        full = jnp.where(can_skip, jnp.logaddexp(stay, a_m2), stay)
+        new = full + emit
+        new = jnp.where(valid, new, _NEG)
+        return new, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, logp[1:])
+    # final: logaddexp of positions 2*llen and 2*llen-1
+    last = jnp.take_along_axis(alpha, (2 * llen)[:, None], axis=1)[:, 0]
+    last2_idx = jnp.maximum(2 * llen - 1, 0)
+    last2 = jnp.take_along_axis(alpha, last2_idx[:, None], axis=1)[:, 0]
+    ll = jnp.logaddexp(last, jnp.where(llen > 0, last2, _NEG))
+    return -ll
+
+
+# ---------------------------------------------------------------------------
+# FFT (reference contrib/fft.cc — cuFFT wrapper, interleaved re/im output)
+# ---------------------------------------------------------------------------
+@defop("fft", arg_names=("data",), param_spec={"compute_size": 128})
+def _fft(attrs, data):
+    """FFT along the last axis; output interleaves real/imag → (..., 2d)
+    (reference contrib/fft-inl.h output layout)."""
+    f = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    return jnp.stack([f.real, f.imag], axis=-1).reshape(
+        data.shape[:-1] + (2 * data.shape[-1],)).astype(data.dtype)
+
+
+@defop("ifft", arg_names=("data",), param_spec={"compute_size": 128})
+def _ifft(attrs, data):
+    """Inverse FFT of interleaved re/im input (..., 2d) → (..., d).
+    Matches the reference's unnormalized cuFFT inverse (scaled by d)."""
+    d = data.shape[-1] // 2
+    x = data.reshape(data.shape[:-1] + (d, 2))
+    c = jax.lax.complex(x[..., 0], x[..., 1])
+    return jnp.fft.ifft(c, axis=-1).real.astype(data.dtype) * d
+
+
+# ---------------------------------------------------------------------------
+# count_sketch (reference contrib/count_sketch.cc)
+# ---------------------------------------------------------------------------
+@defop(
+    "count_sketch",
+    arg_names=("data", "h", "s"),
+    param_spec={"out_dim": 0, "processing_batch_size": 32},
+    no_grad_inputs=("h", "s"),
+)
+def _count_sketch(attrs, data, h, s):
+    """Count-sketch projection: out[:, h[i]] += s[i] * data[:, i]
+    (compact bilinear pooling building block)."""
+    out_dim = int(attrs["out_dim"])
+    hh = h.reshape(-1).astype(jnp.int32)
+    ss = s.reshape(-1).astype(data.dtype)
+    contrib = data * ss[None, :]
+    out = jnp.zeros((data.shape[0], out_dim), data.dtype)
+    return out.at[:, hh].add(contrib)
+
+
+# ---------------------------------------------------------------------------
+# Quantization (reference contrib/quantize.cc)
+# ---------------------------------------------------------------------------
+@defop(
+    "quantize",
+    arg_names=("data", "min_range", "max_range"),
+    param_spec={"out_type": "uint8"},
+    num_outputs=3,
+    no_grad_inputs=("min_range", "max_range"),
+)
+def _quantize(attrs, data, min_range, max_range):
+    """Affine-quantize float→uint8 given calibration range."""
+    qmax = 255.0
+    scale = qmax / (max_range - min_range)
+    q = jnp.clip(jnp.round((data - min_range) * scale), 0, qmax)
+    return q.astype(jnp.uint8), min_range, max_range
+
+
+@defop(
+    "dequantize",
+    arg_names=("data", "min_range", "max_range"),
+    param_spec={"out_type": "float32"},
+    no_grad_inputs=("data", "min_range", "max_range"),
+)
+def _dequantize(attrs, data, min_range, max_range):
+    scale = (max_range - min_range) / 255.0
+    return data.astype(jnp.float32) * scale + min_range
+
+
+# ---------------------------------------------------------------------------
+# SSD multibox ops (reference contrib/multibox_prior.cc, multibox_target.cc,
+# multibox_detection.cc)
+# ---------------------------------------------------------------------------
+@defop(
+    "MultiBoxPrior",
+    arg_names=("data",),
+    param_spec={"sizes": (1.0,), "ratios": (1.0,), "clip": False,
+                "steps": (-1.0, -1.0), "offsets": (0.5, 0.5)},
+    no_grad_inputs=("data",),
+)
+def _multibox_prior(attrs, data):
+    """Anchor generation: (1, H*W*num_anchors, 4) corner-format boxes in
+    [0,1], anchors = sizes + extra ratios (reference multibox_prior-inl.h:
+    num_anchors = sizes + ratios - 1)."""
+    h, w = data.shape[2], data.shape[3]
+    sizes = [float(x) for x in attrs["sizes"]]
+    ratios = [float(x) for x in attrs["ratios"]]
+    steps = attrs["steps"]
+    offs = attrs["offsets"]
+    step_y = float(steps[0]) if float(steps[0]) > 0 else 1.0 / h
+    step_x = float(steps[1]) if float(steps[1]) > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + float(offs[0])) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + float(offs[1])) * step_x
+    # anchor (half-w, half-h) list: all sizes at ratio[0], then ratios[1:] at size[0]
+    wh = []
+    for sz in sizes:
+        r = ratios[0]
+        wh.append((sz * np.sqrt(r) / 2, sz / np.sqrt(r) / 2))
+    for r in ratios[1:]:
+        wh.append((sizes[0] * np.sqrt(r) / 2, sizes[0] / np.sqrt(r) / 2))
+    wh = jnp.asarray(wh, jnp.float32)  # (A, 2): half_w, half_h
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")  # (H, W)
+    centers = jnp.stack([cxg, cyg], axis=-1)[:, :, None, :]  # (H, W, 1, 2)
+    half = wh[None, None, :, :]
+    boxes = jnp.concatenate(
+        [centers - half, centers + half], axis=-1)  # (H, W, A, 4) xmin..ymax
+    boxes = boxes.reshape(1, -1, 4)
+    if attrs["clip"]:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+def _iou_matrix(a, b):
+    """IoU between (N,4) and (M,4) corner boxes → (N,M)."""
+    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    inter = jnp.prod(jnp.clip(br - tl, 0, None), axis=-1)
+    area_a = jnp.prod(jnp.clip(a[:, 2:] - a[:, :2], 0, None), axis=-1)
+    area_b = jnp.prod(jnp.clip(b[:, 2:] - b[:, :2], 0, None), axis=-1)
+    return inter / jnp.clip(area_a[:, None] + area_b[None, :] - inter, 1e-12)
+
+
+@defop(
+    "MultiBoxTarget",
+    arg_names=("anchor", "label", "cls_pred"),
+    param_spec={"overlap_threshold": 0.5, "ignore_label": -1.0,
+                "negative_mining_ratio": -1.0, "negative_mining_thresh": 0.5,
+                "minimum_negative_samples": 0, "variances": (0.1, 0.1, 0.2, 0.2)},
+    num_outputs=3,
+    no_grad_inputs=("anchor", "label", "cls_pred"),
+)
+def _multibox_target(attrs, anchor, label, cls_pred):
+    """Anchor→ground-truth matching producing box regression targets, a
+    regression mask, and per-anchor class targets (reference
+    multibox_target-inl.h). label: (B, num_gt, 5) [cls, xmin, ymin, xmax,
+    ymax], cls = -1 for padding."""
+    anchors = anchor.reshape(-1, 4)
+    na = anchors.shape[0]
+    var = jnp.asarray([float(v) for v in attrs["variances"]], jnp.float32)
+    thresh = float(attrs["overlap_threshold"])
+
+    def per_image(lab):
+        gt_valid = lab[:, 0] >= 0
+        gt_boxes = lab[:, 1:5]
+        iou = _iou_matrix(anchors, gt_boxes)              # (NA, NG)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)                 # (NA,)
+        best_iou = jnp.max(iou, axis=1)
+        matched = best_iou >= thresh
+        # force-match: each valid gt claims its best anchor
+        best_anchor = jnp.argmax(iou, axis=0)             # (NG,)
+        forced = jnp.zeros(na, bool).at[best_anchor].set(gt_valid)
+        forced_gt = jnp.zeros(na, jnp.int32).at[best_anchor].set(
+            jnp.arange(lab.shape[0]))
+        use_forced = forced
+        gt_idx = jnp.where(use_forced, forced_gt, best_gt)
+        pos = matched | use_forced
+        g = gt_boxes[gt_idx]                              # (NA, 4)
+        # encode center-offset targets
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        gw = jnp.clip(g[:, 2] - g[:, 0], 1e-12)
+        gh = jnp.clip(g[:, 3] - g[:, 1], 1e-12)
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        t = jnp.stack([(gcx - acx) / aw / var[0], (gcy - acy) / ah / var[1],
+                       jnp.log(gw / aw) / var[2], jnp.log(gh / ah) / var[3]],
+                      axis=1)
+        loc_target = jnp.where(pos[:, None], t, 0.0).reshape(-1)
+        loc_mask = jnp.where(pos[:, None], 1.0, 0.0).repeat(4, axis=1)[:, :4].reshape(-1)
+        cls_target = jnp.where(pos, lab[gt_idx, 0] + 1.0, 0.0)
+        return loc_target, loc_mask, cls_target
+
+    loc_t, loc_m, cls_t = jax.vmap(per_image)(label)
+    return loc_t, loc_m, cls_t
+
+
+def _nms_loop(boxes, scores, valid, iou_thresh, topk):
+    """Greedy NMS with static iteration count: at each step pick the
+    highest-score surviving box, emit it, suppress overlaps."""
+    n = boxes.shape[0]
+    topk = n if topk <= 0 else min(topk, n)
+
+    def body(_, state):
+        scores_live, keep = state
+        i = jnp.argmax(scores_live)
+        best = scores_live[i]
+        iou = _iou_matrix(boxes[i][None], boxes)[0]
+        suppress = (iou > iou_thresh) & (scores_live > _NEG)
+        scores_live = jnp.where(suppress, _NEG, scores_live)
+        scores_live = scores_live.at[i].set(_NEG)
+        # OR-update: exhausted iterations re-select an index and must not
+        # clear a previously kept box
+        keep = keep.at[i].set(keep[i] | (best > _NEG))
+        return scores_live, keep
+
+    scores0 = jnp.where(valid, scores, _NEG)
+    keep0 = jnp.zeros(n, bool)
+    _, keep = jax.lax.fori_loop(0, topk, body, (scores0, keep0))
+    return keep
+
+
+@defop(
+    "MultiBoxDetection",
+    arg_names=("cls_prob", "loc_pred", "anchor"),
+    param_spec={"clip": True, "threshold": 0.01, "background_id": 0,
+                "nms_threshold": 0.5, "force_suppress": False,
+                "variances": (0.1, 0.1, 0.2, 0.2), "nms_topk": -1},
+    no_grad_inputs=("cls_prob", "loc_pred", "anchor"),
+)
+def _multibox_detection(attrs, cls_prob, loc_pred, anchor):
+    """Decode + per-class NMS → (B, NA, 6) rows [cls_id, score, xmin, ymin,
+    xmax, ymax]; cls_id = -1 marks suppressed rows (reference
+    multibox_detection-inl.h)."""
+    anchors = anchor.reshape(-1, 4)
+    var = jnp.asarray([float(v) for v in attrs["variances"]], jnp.float32)
+    bg = int(attrs["background_id"])
+    thr = float(attrs["threshold"])
+    nms_t = float(attrs["nms_threshold"])
+    topk = int(attrs["nms_topk"])
+
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def per_image(cp, lp):
+        # cp: (num_classes, NA); lp: (NA*4,)
+        l = lp.reshape(-1, 4)
+        cx = l[:, 0] * var[0] * aw + acx
+        cy = l[:, 1] * var[1] * ah + acy
+        w = jnp.exp(l[:, 2] * var[2]) * aw / 2
+        h = jnp.exp(l[:, 3] * var[3]) * ah / 2
+        boxes = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=1)
+        if attrs["clip"]:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        scores = jnp.where(
+            jnp.arange(cp.shape[0])[:, None] == bg, -1.0, cp)  # mask bg row
+        cls_id = jnp.argmax(scores, axis=0)
+        score = jnp.max(scores, axis=0)
+        valid = score > thr
+        keep = _nms_loop(boxes, score, valid, nms_t, topk)
+        # class id re-based past the background row (reference convention)
+        out_cls = jnp.where(keep, (cls_id - (bg == 0)).astype(jnp.float32), -1.0)
+        return jnp.concatenate(
+            [out_cls[:, None], score[:, None], boxes], axis=1)
+
+    return jax.vmap(per_image)(cls_prob, loc_pred)
+
+
+# ---------------------------------------------------------------------------
+# Faster-RCNN proposal (reference contrib/proposal.cc)
+# ---------------------------------------------------------------------------
+@defop(
+    "Proposal",
+    arg_names=("cls_prob", "bbox_pred", "im_info"),
+    param_spec={"rpn_pre_nms_top_n": 6000, "rpn_post_nms_top_n": 300,
+                "threshold": 0.7, "rpn_min_size": 16,
+                "scales": (4.0, 8.0, 16.0, 32.0), "ratios": (0.5, 1.0, 2.0),
+                "feature_stride": 16, "output_score": False,
+                "iou_loss": False},
+    num_outputs=lambda attrs: 2 if attrs["output_score"] else 1,
+    no_grad_inputs=("cls_prob", "bbox_pred", "im_info"),
+)
+def _proposal(attrs, cls_prob, bbox_pred, im_info):
+    """RPN proposal generation: anchors → bbox decode → clip → NMS → top-N
+    rois (batch_idx, x1, y1, x2, y2). Static-shape NMS, batch size 1 as in
+    the reference."""
+    stride = int(attrs["feature_stride"])
+    scales = [float(s) for s in attrs["scales"]]
+    ratios = [float(r) for r in attrs["ratios"]]
+    post_n = int(attrs["rpn_post_nms_top_n"])
+    b, a2, h, w = cls_prob.shape
+    na = a2 // 2
+
+    # base anchors centered at (stride/2, stride/2)
+    base = []
+    ctr = (stride - 1) / 2.0
+    size = stride * stride
+    for r in ratios:
+        ws = np.round(np.sqrt(size / r))
+        hs = np.round(ws * r)
+        for s in scales:
+            base.append([ctr - (ws * s - 1) / 2, ctr - (hs * s - 1) / 2,
+                         ctr + (ws * s - 1) / 2, ctr + (hs * s - 1) / 2])
+    base = jnp.asarray(base, jnp.float32)  # (na, 4)
+
+    shift_x = jnp.arange(w, dtype=jnp.float32) * stride
+    shift_y = jnp.arange(h, dtype=jnp.float32) * stride
+    sy, sx = jnp.meshgrid(shift_y, shift_x, indexing="ij")
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1).reshape(-1, 1, 4)
+    anchors = (base[None] + shifts).reshape(-1, 4)      # (h*w*na, 4)
+
+    scores = cls_prob[0, na:].transpose(1, 2, 0).reshape(-1)  # fg scores
+    deltas = bbox_pred[0].reshape(na, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+
+    aw = anchors[:, 2] - anchors[:, 0] + 1
+    ah = anchors[:, 3] - anchors[:, 1] + 1
+    acx = anchors[:, 0] + aw / 2
+    acy = anchors[:, 1] + ah / 2
+    cx = deltas[:, 0] * aw + acx
+    cy = deltas[:, 1] * ah + acy
+    pw = jnp.exp(deltas[:, 2]) * aw
+    ph = jnp.exp(deltas[:, 3]) * ah
+    boxes = jnp.stack([cx - pw / 2, cy - ph / 2,
+                       cx + pw / 2, cy + ph / 2], axis=1)
+    im_h, im_w = im_info[0, 0], im_info[0, 1]
+    boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, im_w - 1),
+                       jnp.clip(boxes[:, 1], 0, im_h - 1),
+                       jnp.clip(boxes[:, 2], 0, im_w - 1),
+                       jnp.clip(boxes[:, 3], 0, im_h - 1)], axis=1)
+    min_size = float(attrs["rpn_min_size"]) * im_info[0, 2]
+    ok = ((boxes[:, 2] - boxes[:, 0] + 1 >= min_size)
+          & (boxes[:, 3] - boxes[:, 1] + 1 >= min_size))
+    keep = _nms_loop(boxes, scores, ok, float(attrs["threshold"]), post_n)
+    score_rank = jnp.where(keep, scores, _NEG)
+    _, top_idx = jax.lax.top_k(score_rank, post_n)
+    rois = jnp.concatenate(
+        [jnp.zeros((post_n, 1), jnp.float32), boxes[top_idx]], axis=1)
+    if attrs["output_score"]:
+        return rois, scores[top_idx][:, None]
+    return rois

@@ -67,6 +67,10 @@ def _activation(attrs, data):
         return jax.nn.softplus(data)
     if act == "softsign":
         return jax.nn.soft_sign(data)
+    if act == "gelu":  # beyond-reference: transformer stacks (models/transformer.py)
+        return jax.nn.gelu(data)
+    if act == "silu" or act == "swish":
+        return jax.nn.silu(data)
     raise MXNetError("unknown act_type %r" % act)
 
 
@@ -249,9 +253,11 @@ def _pooling(attrs, data):
     padcfg = [(0, 0), (0, 0)] + pads
     ptype = attrs["pool_type"]
     if ptype == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return jax.lax.reduce_window(data, jnp.asarray(init, data.dtype), jax.lax.max, window, strides, padcfg)
-    summed = jax.lax.reduce_window(data, jnp.asarray(0, data.dtype), jax.lax.add, window, strides, padcfg)
+        # init must be a CONCRETE scalar (np, not jnp): reduce_window's
+        # autodiff rule needs a known init value to recognize max-pooling
+        init = -np.inf if jnp.issubdtype(data.dtype, jnp.floating) else np.iinfo(np.dtype(data.dtype)).min
+        return jax.lax.reduce_window(data, np.asarray(init, data.dtype), jax.lax.max, window, strides, padcfg)
+    summed = jax.lax.reduce_window(data, np.asarray(0, data.dtype), jax.lax.add, window, strides, padcfg)
     if ptype == "sum":
         return summed
     # avg: reference divides by full kernel size (count includes padding)

@@ -1,0 +1,27 @@
+"""Hand-written Pallas TPU kernels — the framework's fast-path layer.
+
+Layering mirrors the reference's cuDNN strategy (SURVEY §2.1 #16,
+src/operator/cudnn_*.h): every op has a portable XLA reference
+implementation, and a Pallas kernel is selected when the backend is TPU and
+the shapes qualify; otherwise the reference path runs. Selection is
+centralized in :func:`use_pallas` (the analogue of the cudnn_algoreg
+autotune gate, cudnn_algoreg-inl.h).
+"""
+import functools
+import os
+
+import jax
+
+from . import flash_attention  # noqa: F401
+from . import lstm  # noqa: F401
+from . import fused_update  # noqa: F401
+
+
+@functools.lru_cache(None)
+def on_tpu() -> bool:
+    if os.environ.get("MXNET_TPU_DISABLE_PALLAS", "0") == "1":
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False

@@ -1,0 +1,159 @@
+"""Flash attention — Pallas TPU kernel with online softmax.
+
+The fused fast path behind the MultiHeadAttention op (ops/attention.py) and
+the building block of ring attention (parallel/ring_attention.py). Never
+materializes the (Tq, Tk) score matrix in HBM: a grid cell owns one query
+block, streams key/value blocks through VMEM, and keeps the softmax
+running-max/running-sum in registers (f32) — the standard
+memory-bandwidth-optimal formulation for the MXU.
+
+Falls back to the XLA reference math off-TPU or for non-tile-aligned
+shapes, exactly as the reference falls back from cuDNN to the mshadow
+kernel (src/operator/convolution.cc cudnn_off path).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on TPU-enabled jaxlib builds
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+BLOCK_Q = 256
+BLOCK_K = 256
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale, block_k):
+    """One (batch*head, q-block) grid cell."""
+    q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
+    bq = q.shape[0]
+    tk = k_ref.shape[1]
+    qi = pl.program_id(1)
+    num_k_blocks = pl.cdiv(tk, block_k)
+
+    def body(kb, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (BQ, BK)
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])                # (BQ, BK)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    d = q_ref.shape[-1]
+    init = (jnp.zeros((bq, d), jnp.float32),
+            jnp.full((bq,), _NEG_INF, jnp.float32),
+            jnp.zeros((bq,), jnp.float32))
+    if causal:
+        # only blocks at or left of the diagonal contribute
+        hi = jax.lax.min(num_k_blocks, pl.cdiv((qi + 1) * bq, block_k))
+    else:
+        hi = num_k_blocks
+    acc, _, l = jax.lax.fori_loop(0, hi, body, init)
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _fa_forward(q, k, v, causal, scale, interpret):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    block_q = min(BLOCK_Q, tq)
+    block_k = min(BLOCK_K, tk)
+    kernel = functools.partial(_fa_kernel, causal=causal, scale=scale,
+                               block_k=block_k)
+    kwargs = {}
+    if pltpu is not None and not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, pl.cdiv(tq, block_q)),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * tq * tk * d,
+            bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
+            transcendentals=bh * tq * tk,
+        ),
+        interpret=interpret,
+        **kwargs,
+    )(q, k, v)
+
+
+def _aligned(t, block):
+    return t % min(block, t) == 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q3, k3, v3, causal, scale, interpret):
+    return _fa_forward(q3, k3, v3, causal, scale, interpret)
+
+
+def _flash_fwd(q3, k3, v3, causal, scale, interpret):
+    return _fa_forward(q3, k3, v3, causal, scale, interpret), (q3, k3, v3)
+
+
+def _flash_bwd(causal, scale, interpret, res, g):
+    # Recompute-based backward through the reference math (the kernel and
+    # the reference compute identical values). A blocked Pallas backward is
+    # a planned fast path; XLA still fuses this into a handful of matmuls.
+    from .. import attention as _att
+
+    q3, k3, v3 = res
+
+    def ref(q, k, v):
+        return _att.dot_product_attention(q[:, None], k[:, None], v[:, None],
+                                          causal=causal, scale=scale)[:, 0]
+
+    _, vjp = jax.vjp(ref, q3, k3, v3)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
+    """Attention over (B, H, T, D). Pallas on TPU, XLA reference otherwise."""
+    from .. import attention as _att
+    from . import on_tpu
+
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = False
+        if not on_tpu():
+            return _att.dot_product_attention(q, k, v, causal=causal,
+                                              scale=scale)
+    if not (_aligned(q.shape[-2], BLOCK_Q) and _aligned(k.shape[-2], BLOCK_K)
+            and q.shape[-1] % 128 == 0 and q.shape[-2] >= 8):
+        return _att.dot_product_attention(q, k, v, causal=causal, scale=scale)
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    out = _flash(q.reshape(b * h, tq, d), k.reshape(b * h, tk, d),
+                 v.reshape(b * h, tk, d), causal, scale, interpret)
+    return out.reshape(b, h, tq, d)

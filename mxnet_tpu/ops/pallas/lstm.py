@@ -1,0 +1,53 @@
+"""Pallas LSTM step kernel — the cuDNN-RNN fast-path analogue.
+
+The fused RNN op (ops/rnn_fused.py) hoists input projections out of its
+time scan; what remains per step is ``h @ Wh^T`` plus four gate
+nonlinearities and the cell update. This kernel fuses all of that in one
+VMEM round-trip: the recurrent weight tile feeds the MXU while gate math
+runs on the VPU, instead of XLA's matmul + separate elementwise kernels.
+
+Mirrors the reference's layering where CuDNNRNNOp replaces the generic path
+on qualifying hardware (src/operator/cudnn_rnn-inl.h:22).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _step_kernel(ib_ref, h_ref, c_ref, wh_ref, h_out_ref, c_out_ref, *, hidden):
+    h_prev = h_ref[:]
+    gates = ib_ref[:] + jax.lax.dot_general(
+        h_prev, wh_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    i = jax.nn.sigmoid(gates[:, 0 * hidden:1 * hidden])
+    f = jax.nn.sigmoid(gates[:, 1 * hidden:2 * hidden])
+    g = jnp.tanh(gates[:, 2 * hidden:3 * hidden])
+    o = jax.nn.sigmoid(gates[:, 3 * hidden:4 * hidden])
+    c = f * c_ref[:].astype(jnp.float32) + i * g
+    c_out_ref[:] = c.astype(c_out_ref.dtype)
+    h_out_ref[:] = (o * jnp.tanh(c)).astype(h_out_ref.dtype)
+
+
+def lstm_step(ib, h_prev, c_prev, wh, interpret=False):
+    """One fused LSTM step. ib: (N, 4H) pre-projected input+bias;
+    h_prev/c_prev: (N, H); wh: (4H, H). Returns (h, c)."""
+    n, h4 = ib.shape
+    hidden = h4 // 4
+    out = pl.pallas_call(
+        functools.partial(_step_kernel, hidden=hidden),
+        out_shape=(jax.ShapeDtypeStruct((n, hidden), h_prev.dtype),
+                   jax.ShapeDtypeStruct((n, hidden), c_prev.dtype)),
+        interpret=interpret,
+    )(ib, h_prev, c_prev, wh)
+    return out
+
+
+def use_for(n, hidden):
+    """Qualify shapes: lanes aligned, weights fit VMEM comfortably."""
+    from . import on_tpu
+    return (on_tpu() and hidden % 128 == 0 and n % 8 == 0
+            and 4 * hidden * hidden * 4 <= 8 * 1024 * 1024)

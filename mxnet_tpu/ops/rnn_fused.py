@@ -66,6 +66,11 @@ def _lstm_scan(x_seq, h0, c0, wi, wh, bi, bh, h):
     (cuDNN order, matching FusedRNNCell._gate_names)."""
     ib = x_seq @ wi.T + (bi + bh)  # (T, N, 4H): hoist input projection out of scan
 
+    from .pallas import lstm as _pl_lstm
+    if _pl_lstm.use_for(x_seq.shape[1], h):
+        (h_last, c_last), ys = _lstm_scan_fused(ib, h0, c0, wh)
+        return ys, h_last, c_last
+
     def step(carry, xt):
         h_prev, c_prev = carry
         gates = xt + h_prev @ wh.T
@@ -79,6 +84,51 @@ def _lstm_scan(x_seq, h0, c0, wi, wh, bi, bh, h):
 
     (h_last, c_last), ys = jax.lax.scan(step, (h0, c0), ib)
     return ys, h_last, c_last
+
+
+def _lstm_scan_jnp(ib, h0, c0, wh, h):
+    def step(carry, xt):
+        h_prev, c_prev = carry
+        gates = xt + h_prev @ wh.T
+        i = jax.nn.sigmoid(gates[:, 0 * h: 1 * h])
+        f = jax.nn.sigmoid(gates[:, 1 * h: 2 * h])
+        g = jnp.tanh(gates[:, 2 * h: 3 * h])
+        o = jax.nn.sigmoid(gates[:, 3 * h: 4 * h])
+        c = f * c_prev + i * g
+        hh = o * jnp.tanh(c)
+        return (hh, c), hh
+
+    return jax.lax.scan(step, (h0, c0), ib)
+
+
+@jax.custom_vjp
+def _lstm_scan_fused(ib, h0, c0, wh):
+    """Scan whose per-step body is the Pallas fused step kernel
+    (ops/pallas/lstm.py) — recurrent matmul + gates in one VMEM pass.
+    Backward recomputes through the jnp formulation (identical math)."""
+    from .pallas import lstm as _pl_lstm
+
+    def step(carry, xt):
+        h_prev, c_prev = carry
+        hh, c = _pl_lstm.lstm_step(xt, h_prev, c_prev, wh)
+        return (hh, c), hh
+
+    return jax.lax.scan(step, (h0, c0), ib)
+
+
+def _lstm_fused_fwd(ib, h0, c0, wh):
+    return _lstm_scan_fused(ib, h0, c0, wh), (ib, h0, c0, wh)
+
+
+def _lstm_fused_bwd(res, g):
+    ib, h0, c0, wh = res
+    h = h0.shape[-1]
+    _, vjp = jax.vjp(lambda a, b, c, w: _lstm_scan_jnp(a, b, c, w, h),
+                     ib, h0, c0, wh)
+    return vjp(g)
+
+
+_lstm_scan_fused.defvjp(_lstm_fused_fwd, _lstm_fused_bwd)
 
 
 def _gru_scan(x_seq, h0, wi, wh, bi, bh, h):

@@ -14,6 +14,33 @@ from .. import symbol
 from ..base import MXNetError
 
 
+def _batch_ref(sym_, batch_axis, ndim):
+    """A (batch, 1) zero symbol whose batch dim tracks ``sym_``'s.
+
+    Forward-shape-inference-friendly replacement for the reference's
+    0-batch begin_state convention (rnn_cell.py state_info shape (0, H)):
+    instead of an unknown dim unified by bidirectional InferShape, the
+    batch size flows forward from the input symbol. XLA folds the
+    slice*0 into a constant, so no runtime cost."""
+    ref = sym_
+    for ax in range(ndim):
+        if ax != batch_axis:
+            ref = symbol.slice_axis(ref, axis=ax, begin=0, end=1)
+    return symbol.Reshape(ref, shape=(-1, 1)) * 0
+
+
+def _zeros_like_batch(ref_n1):
+    """begin_state func: zeros of state_info shape, 0-dims = batch."""
+
+    def func(name=None, shape=None, **kw):
+        s = tuple(shape)
+        rshape = tuple(-1 if d == 0 else 1 for d in s)
+        z = symbol.Reshape(ref_n1, shape=rshape)
+        return symbol.broadcast_to(z, shape=s)
+
+    return func
+
+
 class RNNParams:
     """Container for shared cell parameters (reference rnn_cell.py RNNParams)."""
 
@@ -133,7 +160,8 @@ class BaseRNNCell:
             )
             inputs = [inputs[i] for i in range(length)]
         if begin_state is None:
-            begin_state = self.begin_state()
+            begin_state = self.begin_state(
+                func=_zeros_like_batch(_batch_ref(inputs[0], 0, 2)))
         states = begin_state
         outputs = []
         for i in range(length):
@@ -362,7 +390,8 @@ class FusedRNNCell(BaseRNNCell):
         if axis == 1:  # NTC -> TNC for the fused kernel (time-major scan)
             inputs = symbol.SwapAxis(inputs, dim1=0, dim2=1)
         if begin_state is None:
-            begin_state = self.begin_state()
+            begin_state = self.begin_state(
+                func=_zeros_like_batch(_batch_ref(inputs, 1, 3)))
         states = begin_state
         rnn_kwargs = dict(
             data=inputs, parameters=self._parameter, state=states[0],
@@ -607,7 +636,8 @@ class BidirectionalCell(BaseRNNCell):
                                          squeeze_axis=1)
             inputs = [inputs[i] for i in range(length)]
         if begin_state is None:
-            begin_state = self.begin_state()
+            begin_state = self.begin_state(
+                func=_zeros_like_batch(_batch_ref(inputs[0], 0, 2)))
         states = begin_state
         l_cell, r_cell = self._cells
         l_outputs, l_states = l_cell.unroll(

@@ -1,0 +1,128 @@
+"""Contrib op tests (reference tests/python/unittest/test_operator.py CTC /
+multibox sections)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def test_fft_ifft_roundtrip():
+    x = np.random.randn(4, 16).astype(np.float32)
+    f = nd.contrib_assert = nd.fft(nd.array(x))
+    assert f.shape == (4, 32)
+    back = nd.ifft(f)
+    # reference ifft is unnormalized (scaled by d)
+    np.testing.assert_allclose(back.asnumpy() / 16.0, x, atol=1e-4)
+
+
+def test_quantize_dequantize():
+    x = np.random.uniform(-1, 1, (8, 8)).astype(np.float32)
+    q, mn, mx_ = nd.quantize(nd.array(x), nd.array([-1.0]), nd.array([1.0]))
+    assert q.asnumpy().dtype == np.uint8
+    d = nd.dequantize(q, mn, mx_)
+    np.testing.assert_allclose(d.asnumpy(), x, atol=2.0 / 255 + 1e-6)
+
+
+def test_ctc_loss_trivial():
+    # single symbol, T=4: loss must equal -log P(path collapses to [1])
+    T, B, A = 4, 2, 3
+    data = np.zeros((T, B, A), np.float32)
+    data[:, :, 1] = 5.0  # strongly predict symbol 1
+    label = np.array([[1, 0], [1, 0]], np.float32)
+    loss = nd.ctc_loss(nd.array(data), nd.array(label)).asnumpy()
+    assert loss.shape == (B,)
+    assert (loss > 0).all() and (loss < 1.0).all()  # near-certain path
+
+
+def test_ctc_loss_uniform_matches_closed_form():
+    # uniform logits: P(any path) = A^-T; number of valid paths for L=1,
+    # T=2, is 3 ([b,1],[1,b],[1,1]) → loss = -log(3/9)
+    T, B, A = 2, 1, 3
+    data = np.zeros((T, B, A), np.float32)
+    label = np.array([[1]], np.float32)
+    loss = float(nd.ctc_loss(nd.array(data), nd.array(label)).asnumpy()[0])
+    np.testing.assert_allclose(loss, -np.log(3.0 / 9.0), rtol=1e-5)
+
+
+def test_multibox_prior():
+    x = nd.zeros((1, 3, 4, 4))
+    anchors = nd.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1.0, 2.0))
+    a = anchors.asnumpy()
+    assert a.shape == (1, 4 * 4 * 3, 4)
+    # centers in [0,1], first anchor centered at (0.125, 0.125)
+    c = (a[0, 0, :2] + a[0, 0, 2:]) / 2
+    np.testing.assert_allclose(c, [0.125, 0.125], atol=1e-6)
+
+
+def test_multibox_target_and_detection():
+    anchors = nd.MultiBoxPrior(nd.zeros((1, 3, 2, 2)), sizes=(0.4,))
+    na = anchors.shape[1]
+    # one gt box matching the top-left anchor region
+    label = np.array([[[0, 0.0, 0.0, 0.5, 0.5]]], np.float32)
+    cls_pred = nd.zeros((1, 2, na))
+    loc_t, loc_m, cls_t = nd.MultiBoxTarget(anchors, nd.array(label), cls_pred)
+    assert loc_t.shape == (1, na * 4)
+    ct = cls_t.asnumpy()[0]
+    assert (ct == 1).sum() >= 1  # at least the forced match
+    # detection decode round-trip: zero offsets → anchors themselves
+    cls_prob = np.zeros((1, 2, na), np.float32)
+    cls_prob[0, 1, 0] = 0.9
+    det = nd.MultiBoxDetection(nd.array(cls_prob), nd.zeros((1, na * 4)),
+                               anchors, nms_threshold=0.5)
+    d = det.asnumpy()
+    assert d.shape == (1, na, 6)
+    kept = d[0][d[0, :, 0] >= 0]
+    assert len(kept) >= 1
+    assert abs(kept[0, 1] - 0.9) < 1e-5
+
+
+def test_proposal():
+    h = w = 4
+    na = 3 * 4  # ratios * scales
+    cls = np.random.uniform(size=(1, 2 * na, h, w)).astype(np.float32)
+    bbox = np.zeros((1, 4 * na, h, w), np.float32)
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    rois = nd.Proposal(nd.array(cls), nd.array(bbox), nd.array(im_info),
+                       rpn_post_nms_top_n=8, rpn_min_size=0)
+    assert rois.shape == (8, 5)
+    r = rois.asnumpy()
+    assert (r[:, 1:] >= 0).all() and (r[:, 3] <= 64).all()
+
+
+def test_count_sketch():
+    x = np.random.randn(2, 8).astype(np.float32)
+    h = np.array([0, 1, 2, 3, 0, 1, 2, 3], np.float32)
+    s = np.ones(8, np.float32)
+    out = nd.count_sketch(nd.array(x), nd.array(h), nd.array(s), out_dim=4)
+    expected = x[:, :4] + x[:, 4:]
+    np.testing.assert_allclose(out.asnumpy(), expected, rtol=1e-5)
+
+
+def test_layernorm_rmsnorm():
+    x = np.random.randn(4, 16).astype(np.float32)
+    g = np.ones(16, np.float32)
+    b = np.zeros(16, np.float32)
+    out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b)).asnumpy()
+    ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    out = nd.RMSNorm(nd.array(x), nd.array(g)).asnumpy()
+    ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_multi_head_attention_matches_reference():
+    from mxnet_tpu.ops.attention import dot_product_attention
+    b, t, h, d = 2, 8, 2, 4
+    q = np.random.randn(b, t, h * d).astype(np.float32)
+    k = np.random.randn(b, t, h * d).astype(np.float32)
+    v = np.random.randn(b, t, h * d).astype(np.float32)
+    out = nd.MultiHeadAttention(nd.array(q), nd.array(k), nd.array(v),
+                                num_heads=h, causal=True).asnumpy()
+    import jax.numpy as jnp
+    qh = jnp.asarray(q).reshape(b, t, h, d).transpose(0, 2, 1, 3)
+    kh = jnp.asarray(k).reshape(b, t, h, d).transpose(0, 2, 1, 3)
+    vh = jnp.asarray(v).reshape(b, t, h, d).transpose(0, 2, 1, 3)
+    ref = dot_product_attention(qh, kh, vh, causal=True)
+    ref = np.asarray(ref.transpose(0, 2, 1, 3).reshape(b, t, h * d))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,73 @@
+"""Model zoo tests — analogue of the reference's symbol-construction checks
+in tests/python/unittest/test_symbol.py + train smoke tests (SURVEY §4.5)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+from mxnet_tpu.io import NDArrayIter
+
+
+IMAGE_MODELS = [
+    ("mlp", (2, 1, 28, 28)),
+    ("lenet", (2, 1, 28, 28)),
+    ("alexnet", (2, 3, 224, 224)),
+    ("vgg16", (2, 3, 224, 224)),
+    ("inception-bn", (2, 3, 224, 224)),
+    ("inception-v3", (2, 3, 299, 299)),
+    ("resnet-18", (2, 3, 224, 224)),
+    ("resnet-50", (2, 3, 224, 224)),
+    ("resnet-152", (2, 3, 224, 224)),
+]
+
+
+@pytest.mark.parametrize("name,shape", IMAGE_MODELS)
+def test_image_model_shapes(name, shape):
+    s = models.get_symbol(name, num_classes=10)
+    _, out_shapes, _ = s.infer_shape(data=shape)
+    assert out_shapes[0] == (shape[0], 10)
+
+
+def test_seq_model_shapes():
+    s = models.get_symbol("lstm-lm", num_classes=50, seq_len=10,
+                          num_embed=16, num_hidden=16)
+    _, outs, _ = s.infer_shape(data=(4, 10), softmax_label=(4, 10))
+    assert outs[0] == (40, 50)
+    s = models.get_symbol("lstm-lm", num_classes=50, seq_len=10,
+                          num_embed=16, num_hidden=16, fused=True)
+    _, outs, _ = s.infer_shape(data=(4, 10), softmax_label=(4, 10))
+    assert outs[0] == (40, 50)
+    s = models.get_symbol("transformer-lm", num_classes=50, seq_len=16,
+                          num_layers=1, num_heads=2, model_dim=32, ffn_dim=64)
+    _, outs, _ = s.infer_shape(data=(4, 16), softmax_label=(4, 16))
+    assert outs[0] == (64, 50)
+
+
+def test_lenet_trains_and_learns():
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = models.get_symbol("mlp", num_classes=2, hidden=(16,))
+    m = mx.mod.Module(net, context=mx.cpu())
+    # separable toy problem
+    X = np.random.randn(64, 8).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    it = NDArrayIter(X, y, batch_size=16, shuffle=True)
+    metric = mx.metric.Accuracy()
+    m.fit(it, num_epoch=10, optimizer='sgd',
+          optimizer_params={'learning_rate': 0.5},
+          eval_metric=metric)
+    it.reset()
+    score = m.score(it, mx.metric.Accuracy())
+    acc = dict(score)['accuracy']
+    assert acc > 0.9, acc
+
+
+def test_transformer_train_step():
+    net = models.get_symbol("transformer-lm", num_classes=30, seq_len=8,
+                            num_layers=1, num_heads=2, model_dim=16,
+                            ffn_dim=32)
+    m = mx.mod.Module(net, context=mx.cpu())
+    X = np.random.randint(0, 30, (8, 8)).astype(np.float32)
+    y = np.random.randint(0, 30, (8, 8)).astype(np.float32)
+    m.fit(NDArrayIter(X, y, batch_size=4), num_epoch=1,
+          optimizer='adam', optimizer_params={'learning_rate': 1e-3})
