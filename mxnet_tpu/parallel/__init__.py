@@ -1,0 +1,26 @@
+"""Parallelism subsystem — the TPU-native replacement for the reference's
+entire communication plane (SURVEY §2.2, §5.8):
+
+==========================  =================================================
+reference mechanism          TPU-native realization (this package)
+==========================  =================================================
+kvstore 'device' reduce      in-graph psum over the "data" mesh axis
+kvstore dist_sync / ps-lite  global all-reduce over ICI+DCN (jax.distributed)
+group2ctx model parallel     NamedSharding / shard_map placement (mesh.py)
+(absent in reference) TP     tensor_parallel.py sharding rules
+(absent) SP / long context   ring_attention.py (ppermute ring over "seq")
+(absent) PP micro-batching   pipeline.py (SPMD shift-register pipeline)
+tools/bandwidth harness      collectives.bus_bandwidth
+==========================  =================================================
+
+Mesh axes are canonically named ("data", "seq", "pipe", "model").
+"""
+from .mesh import MeshConfig, auto_mesh, make_mesh, AXES
+from . import collectives
+from .collectives import (all_reduce, all_gather, reduce_scatter, ring_shift,
+                          barrier, bus_bandwidth)
+from . import tensor_parallel
+from . import ring_attention
+from . import pipeline
+from . import transformer
+from . import dist

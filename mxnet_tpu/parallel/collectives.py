@@ -1,0 +1,92 @@
+"""Collective primitives + bandwidth harness.
+
+The reference reduces gradients with hand-written tree-sums and P2P copies
+(CommCPU/CommDevice, src/kvstore/comm.h:62-373) and ships a bus-bandwidth
+measurement tool (tools/bandwidth/, cited by docs/how_to/perf.md). Here the
+primitives are XLA collectives (psum/all_gather/ppermute/reduce_scatter)
+addressed by mesh axis name — usable both inside shard_map'd code and, via
+the jitted wrappers below, on full arrays from host-level code (the
+imperative kvstore path).
+"""
+from __future__ import annotations
+
+import functools
+import time
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+
+# --- in-shard_map primitives (use inside manually-sharded code) -----------
+def all_reduce(x, axis_name):
+    """Sum across a mesh axis (reference Comm::Reduce, comm.h:18-56)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, axis=0):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                                tiled=True)
+
+
+def ring_shift(x, axis_name, shift=1):
+    """Send shard to the next device along a ring (ppermute) — the
+    building block of ring attention and the SPMD pipeline."""
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+# --- host-level collectives over a mesh (imperative kvstore path) ---------
+def mesh_all_reduce(x, mesh: Mesh, axis: str = "data"):
+    """All-reduce stacked per-device contributions: x has a leading axis of
+    size mesh.shape[axis] (one slot per device — the kvstore Push value
+    list, kvstore_local.h:50-73); returns the replicated sum without the
+    leading axis."""
+    n = mesh.shape[axis]
+    assert x.shape[0] == n, (x.shape, n)
+
+    def f(s):
+        return jax.lax.psum(s[0], axis)
+
+    fn = shard_map(f, mesh=mesh, in_specs=(P(axis),), out_specs=P())
+    return fn(x)
+
+
+def barrier(mesh: Mesh):
+    """Cross-device barrier: a tiny all-reduce forced to completion
+    (reference ps::Postoffice::Barrier semantics)."""
+    x = jnp.zeros((mesh.shape["data"], 1), jnp.float32)
+    mesh_all_reduce(x, mesh, "data").block_until_ready()
+
+
+def bus_bandwidth(mesh: Mesh, axis: str = "data", size_mb: float = 64.0,
+                  iters: int = 10, dtype=jnp.float32):
+    """Measure all-reduce bus bandwidth over a mesh axis — the analogue of
+    the reference's tools/bandwidth harness. Returns GB/s of bus bandwidth
+    using the standard ring-allreduce accounting 2*(n-1)/n * bytes."""
+    n = int(np.prod([mesh.shape[a] for a in (axis,)]))
+    itemsize = jnp.dtype(dtype).itemsize
+    num = int(size_mb * 1024 * 1024 / itemsize) // n * n
+    x = jnp.ones((num,), dtype)
+    x = jax.device_put(x, NamedSharding(mesh, P(axis)))
+
+    def f(s):
+        return jax.lax.psum(s, axis)
+
+    fn = jax.jit(shard_map(f, mesh=mesh, in_specs=(P(axis),), out_specs=P()))
+    fn(x).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    bus_bytes = 2 * (n - 1) / max(n, 1) * num * itemsize
+    return bus_bytes / dt / 1e9

@@ -1,0 +1,88 @@
+"""Multi-host distributed runtime.
+
+Replaces the reference's ps-lite process fabric (SURVEY §2.1 #37, §3.4):
+scheduler → jax.distributed coordinator; DMLC_ROLE/DMLC_PS_ROOT_URI env →
+coordinator_address/process_id env; worker barrier →
+multihost_utils.sync_global_devices; dead-node query
+(kvstore_dist.h:159-168) → coordinator client health; tools/launch.py →
+launch() helper spawning one process per host.
+
+There are no separate 'server' processes: the optimizer state lives
+sharded across the same mesh that computes (SURVEY §5.8 translation), so
+every process is a worker.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import jax
+
+
+_initialized = False
+
+
+def init(coordinator_address: Optional[str] = None,
+         num_processes: Optional[int] = None,
+         process_id: Optional[int] = None):
+    """Initialize the multi-host runtime (idempotent).
+
+    Resolution order: explicit args → MXNET_TPU_* env vars → JAX
+    auto-detection (TPU pod metadata). Single-process when nothing is
+    configured — the same degradation as kvstore 'local' vs 'dist'."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "MXNET_TPU_COORDINATOR")
+    if num_processes is None and "MXNET_TPU_NUM_PROCS" in os.environ:
+        num_processes = int(os.environ["MXNET_TPU_NUM_PROCS"])
+    if process_id is None and "MXNET_TPU_PROC_ID" in os.environ:
+        process_id = int(os.environ["MXNET_TPU_PROC_ID"])
+    if coordinator_address is None and num_processes in (None, 1):
+        _initialized = True  # single-process mode
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    _initialized = True
+
+
+def rank() -> int:
+    """This process's rank (reference KVStore::get_rank, kvstore.h:227)."""
+    return jax.process_index()
+
+
+def size() -> int:
+    """World size (reference KVStore::get_group_size, kvstore.h:232)."""
+    return jax.process_count()
+
+
+def barrier(name: str = "barrier"):
+    """Global process barrier (reference Barrier → ps::Postoffice)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
+def num_dead_nodes(timeout_s: float = 0.0) -> int:
+    """Dead-node surface (reference MXKVStoreGetNumDeadNode,
+    kvstore_dist.h:159-168). Under jax.distributed a failed host aborts
+    the job rather than running degraded, so a live call always sees 0;
+    the API exists so reference callers port cleanly, and the timeout is
+    honored as a liveness probe window."""
+    if timeout_s > 0:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            time.sleep(min(0.1, deadline - time.time()))
+    return 0
+
+
+def is_recovery() -> bool:
+    """Recovery flag (reference ps::Postoffice::is_recovery). Restarted
+    jobs resume from checkpoints (orbax/save_checkpoint) instead of
+    rejoining live — always False."""
+    return False

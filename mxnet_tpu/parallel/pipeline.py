@@ -1,0 +1,82 @@
+"""Micro-batched pipeline parallelism (GPipe schedule) over the "pipe" axis.
+
+The reference only has layer-placement model parallelism with no
+micro-batching (SURVEY §2.2: group2ctx + PlaceDevice inserting
+_CrossDeviceCopy, example/model-parallel-lstm) — its pipeline overlap falls
+out of engine dataflow. Here the same overlap is expressed as an SPMD
+shift-register: every device runs the identical program, holds one stage's
+parameters (sharded over "pipe"), and at each tick applies its stage and
+ppermutes the activation to its neighbor. n_micro microbatches drain in
+n_micro + n_stages - 1 ticks; forward and backward of in-flight
+microbatches overlap across devices exactly as the engine overlapped
+per-device segments.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+
+def spmd_pipeline_local(stage_fn, stage_params, x_mb, *, axis="pipe"):
+    """Per-device pipeline body (call inside shard_map).
+
+    stage_fn(stage_params, h) -> h (shape-preserving).
+    stage_params: this device's stage parameters (leading stage axis
+    already consumed by the shard_map in_spec).
+    x_mb: (n_micro, mb, ...) all microbatches (replicated).
+    Returns (n_micro, mb, ...) outputs of the LAST stage (replicated via a
+    final psum-broadcast)."""
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    n_micro = x_mb.shape[0]
+    steps = n_micro + n - 1
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def tick(carry, t):
+        h_recv, out = carry
+        h_in = jnp.where(idx == 0,
+                         x_mb[jnp.minimum(t, n_micro - 1)], h_recv)
+        h_out = stage_fn(stage_params, h_in)
+        h_next = jax.lax.ppermute(h_out, axis, perm)
+        slot = t - (n - 1)
+        emit = (idx == n - 1) & (slot >= 0)
+        out = jnp.where(
+            emit,
+            jax.lax.dynamic_update_index_in_dim(
+                out, h_out, jnp.maximum(slot, 0), 0),
+            out)
+        return (h_next, out), None
+
+    h0 = jnp.zeros_like(x_mb[0])
+    out0 = jnp.zeros_like(x_mb)
+    (_, out), _ = jax.lax.scan(tick, (h0, out0), jnp.arange(steps))
+    # broadcast the last stage's buffer to every pipe rank
+    out = jax.lax.psum(jnp.where(idx == n - 1, out, jnp.zeros_like(out)),
+                       axis)
+    return out
+
+
+def spmd_pipeline(stage_fn, params, x, mesh: Mesh, n_micro: int,
+                  axis: str = "pipe"):
+    """Full-array entry. params: pytree with leading axis n_stages
+    (sharded over `axis`); x: (batch, ...) split into n_micro microbatches.
+    Mainly for tests — real models embed spmd_pipeline_local inside their
+    own shard_map (parallel/transformer.py)."""
+    n = mesh.shape[axis]
+    b = x.shape[0]
+    assert b % n_micro == 0
+    x_mb = x.reshape((n_micro, b // n_micro) + x.shape[1:])
+
+    def body(p, xm):
+        sp = jax.tree_util.tree_map(lambda a: a[0], p)  # squeeze stage axis
+        return spmd_pipeline_local(stage_fn, sp, xm, axis=axis)
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis), params)
+    fn = shard_map(body, mesh=mesh, in_specs=(pspec, P()), out_specs=P(),
+                   check_rep=False)
+    out = fn(params, x_mb)
+    return out.reshape((b,) + out.shape[2:])

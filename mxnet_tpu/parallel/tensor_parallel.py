@@ -1,0 +1,76 @@
+"""Tensor-parallel sharding rules for Symbol-executor parameters.
+
+The reference has NO intra-op sharding (SURVEY §2.2 "Tensor parallelism:
+absent"); its closest mechanism is ctx_group placement (AttrScope,
+python/mxnet/attribute.py). This module is the idiomatic TPU upgrade: a
+pattern → PartitionSpec rule table applied to an executor's argument dict,
+after which XLA's sharding propagation (GSPMD) partitions the matmuls and
+inserts the collectives — no manual comm code for the annotated path.
+
+The ctx_group attribute from AttrScope survives: rules may target it via
+``group:<name>`` patterns, so reference-style ``with mx.AttrScope
+(ctx_group='dev1')`` models map onto mesh axes instead of gpu ids
+(SURVEY §2.2 model-parallel row, example/model-parallel-lstm/lstm.py).
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# (regex over param name, spec builder given ndim) — first match wins.
+# Megatron convention for transformer params on FC weights of shape
+# (out_features, in_features) [reference FC layout, fully_connected-inl.h]:
+# column-parallel = shard out axis; row-parallel = shard in axis.
+DEFAULT_RULES: List[Tuple[str, P]] = [
+    (r".*(_q|_k|_v|_qkv)_weight$", P("model", None)),
+    (r".*(_o|_proj)_weight$", P(None, "model")),
+    (r".*_ffn1_weight$", P("model", None)),
+    (r".*_ffn2_weight$", P(None, "model")),
+    (r".*embed_weight$", P(None, "model")),
+    (r"pred_weight$", P("model", None)),
+    (r".*(_q|_k|_v|_qkv|_ffn1)_bias$", P("model")),
+]
+
+
+def spec_for(name: str, shape, rules=None, attrs: Dict[str, str] = None) -> P:
+    """Resolve the PartitionSpec for one parameter."""
+    rules = DEFAULT_RULES if rules is None else rules
+    group = (attrs or {}).get("__ctx_group__")
+    for pat, spec in rules:
+        if pat.startswith("group:"):
+            if group == pat[len("group:"):]:
+                return spec
+            continue
+        if re.match(pat, name):
+            if len(spec) <= len(shape):
+                return spec
+    return P()
+
+
+def shard_arg_dict(arg_dict, mesh: Mesh, rules=None, attrs_by_name=None):
+    """device_put every NDArray in an executor arg dict per the rules.
+    Subsequent jit executions respect the input shardings and GSPMD
+    propagates them through the graph (the PlaceDevice-pass analogue)."""
+    from ..ndarray import NDArray
+
+    for name, arr in arg_dict.items():
+        spec = spec_for(name, arr.shape, rules,
+                        (attrs_by_name or {}).get(name))
+        sh = NamedSharding(mesh, spec)
+        if isinstance(arr, NDArray):
+            arr._data = jax.device_put(arr._data, sh)
+        else:
+            arg_dict[name] = jax.device_put(arr, sh)
+    return arg_dict
+
+
+def data_parallel_sharding(mesh: Mesh, ndim: int, batch_axis: int = 0):
+    """Sharding for a data tensor: batch over "data" (× "seq" if the
+    tensor has a sequence axis handled elsewhere)."""
+    spec = [None] * ndim
+    spec[batch_axis] = "data"
+    return NamedSharding(mesh, P(*spec))

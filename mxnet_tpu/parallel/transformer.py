@@ -1,0 +1,203 @@
+"""Fully-sharded transformer training step: DP x SP x PP x TP in one
+shard_map program.
+
+This is the framework's flagship distributed training path — the composed
+demonstration that the mesh axes from mesh.py all work together:
+
+- "data":  batch sharding; gradient psum (the kvstore-'device' analogue,
+           SURVEY §5.8).
+- "seq":   ring attention over sequence chunks (ring_attention.py).
+- "pipe":  GPipe shift-register over layer stages (pipeline.py).
+- "model": Megatron-style tensor parallelism — QKV/FFN-in weights
+           column-sharded, out-proj/FFN-out row-sharded, one psum per
+           block half.
+
+Everything is manual-collective SPMD inside ONE shard_map, so XLA sees the
+exact communication schedule; jax.grad differentiates through it, giving
+the reversed pipeline/ring schedules for backward automatically.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from .pipeline import spmd_pipeline_local
+from .ring_attention import _ring_attn_local
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256
+    dm: int = 64
+    heads: int = 4
+    dff: int = 128
+    layers_per_stage: int = 1
+    seq_len: int = 32
+    dtype: Any = jnp.float32
+
+
+def init_params(cfg: TransformerConfig, n_stages: int, key=None):
+    """Stacked parameters: layer weights carry leading axes
+    (n_stages, layers_per_stage, ...) — "pipe" shards axis 0."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 8)
+    d, f, v = cfg.dm, cfg.dff, cfg.vocab
+    L = (n_stages, cfg.layers_per_stage)
+
+    def nrm(k, shape, scale):
+        return (jax.random.normal(k, shape) * scale).astype(cfg.dtype)
+
+    return {
+        "embed": nrm(ks[0], (v, d), 0.02),
+        "wqkv": nrm(ks[1], L + (d, 3 * d), d ** -0.5),
+        "wo": nrm(ks[2], L + (d, d), d ** -0.5),
+        "w1": nrm(ks[3], L + (d, f), d ** -0.5),
+        "w2": nrm(ks[4], L + (f, d), f ** -0.5),
+        "ln1": jnp.ones(L + (d,), cfg.dtype),
+        "ln2": jnp.ones(L + (d,), cfg.dtype),
+        "lnf": jnp.ones((d,), cfg.dtype),
+        "unembed": nrm(ks[5], (d, v), d ** -0.5),
+    }
+
+
+def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
+    """Mesh shardings: "pipe" on the stage axis, "model" on the TP dim."""
+    return {
+        "embed": P(None, "model"),
+        "wqkv": P("pipe", None, None, "model"),
+        "wo": P("pipe", None, "model", None),
+        "w1": P("pipe", None, None, "model"),
+        "w2": P("pipe", None, "model", None),
+        "ln1": P("pipe", None, None),
+        "ln2": P("pipe", None, None),
+        "lnf": P(None),
+        "unembed": P(None, "model"),
+    }
+
+
+def _ln(x, g):
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-5) * g
+
+
+def _layer(p, x, cfg: TransformerConfig, li):
+    """One transformer layer with TP (model axis) + SP (seq axis ring
+    attention). x: local (b, t_local, d); weights: local TP shards."""
+    dh = cfg.dm // cfg.heads
+    # fused QKV layout is HEADS-MAJOR (d, heads*3*dh) so the "model"-axis
+    # shard boundary falls between whole heads, never inside one
+    heads_local = p["wqkv"].shape[-1] // (3 * dh)
+    h = _ln(x, p["ln1"][li])
+    qkv = h @ p["wqkv"][li]                      # (b, t, h_loc*3*dh)
+    b, t, _ = qkv.shape
+    qkv = qkv.reshape(b, t, heads_local, 3, dh).transpose(3, 0, 2, 1, 4)
+    q, k, v = qkv[0], qkv[1], qkv[2]             # (b, h_loc, t, dh)
+    att = _ring_attn_local(q, k, v, axis_name="seq", causal=True,
+                           chunk=t)
+    att = att.transpose(0, 2, 1, 3).reshape(b, t, heads_local * dh)
+    o = att @ p["wo"][li]                        # partial over TP shards
+    o = jax.lax.psum(o, "model")
+    x = x + o
+    h = _ln(x, p["ln2"][li])
+    h = jax.nn.gelu(h @ p["w1"][li])
+    h = h @ p["w2"][li]
+    h = jax.lax.psum(h, "model")
+    return x + h
+
+
+def _stage_fn(stage_params, h, cfg: TransformerConfig):
+    for li in range(cfg.layers_per_stage):
+        h = _layer(stage_params, h, cfg, li)
+    return h
+
+
+def make_train_step(mesh: Mesh, cfg: TransformerConfig, n_micro: int = None,
+                    lr: float = 1e-2):
+    """Returns (train_step, sharded_init) where
+    train_step(params, tokens, targets) -> (loss, new_params) is jitted
+    over the full 4-axis mesh with SGD applied in-graph — the
+    'update_on_kvstore inside the step' design (SURVEY §7 table)."""
+    n_pipe = mesh.shape["pipe"]
+    if n_micro is None:
+        n_micro = max(2, n_pipe)
+    specs = param_specs(cfg)
+
+    def local_fwd(params, tokens, targets):
+        """Per-device program. tokens: (b_loc, t_loc) ints;
+        params: local shards per param_specs."""
+        x = jnp.take(params["embed"], tokens, axis=0)  # (b, t, d/1) emb TP?
+        # embed is column(model)-sharded: gather the full d via all_gather
+        x = jax.lax.all_gather(x, "model", axis=-1, tiled=True)
+        b = x.shape[0]
+        assert b % n_micro == 0, "local batch %d vs n_micro %d" % (b, n_micro)
+        x_mb = x.reshape((n_micro, b // n_micro) + x.shape[1:])
+
+        def stage(sp_params, h):
+            # strip the local stage axis (pipe shards it fully: size 1)
+            sp = jax.tree_util.tree_map(lambda a: a[0], sp_params)
+            return _stage_fn(sp, h, cfg)
+
+        stage_params = {k2: params[k2] for k2 in
+                        ("wqkv", "wo", "w1", "w2", "ln1", "ln2")}
+        out = spmd_pipeline_local(stage, stage_params, x_mb, axis="pipe")
+        out = out.reshape((b,) + out.shape[2:])
+        out = _ln(out, params["lnf"])
+        logits = out @ params["unembed"]             # (b, t, v/tp) TP-sharded
+        # stable softmax-CE with the vocab axis sharded over "model"
+        mx_loc = jnp.max(logits, axis=-1)
+        # max shift is gradient-free for softmax-CE (cancels exactly);
+        # pmax also has no differentiation rule
+        mx_all = jax.lax.pmax(jax.lax.stop_gradient(mx_loc), "model")
+        z = jnp.exp(logits - mx_all[..., None])
+        denom = jax.lax.psum(jnp.sum(z, -1), "model")
+        # local one-hot of targets that fall in this shard's vocab slice
+        vloc = logits.shape[-1]
+        voff = jax.lax.axis_index("model") * vloc
+        tloc = targets - voff
+        in_shard = (tloc >= 0) & (tloc < vloc)
+        tgt_logit = jnp.take_along_axis(
+            logits, jnp.clip(tloc, 0, vloc - 1)[..., None], axis=-1)[..., 0]
+        tgt_logit = jax.lax.psum(jnp.where(in_shard, tgt_logit, 0.0), "model")
+        nll = jnp.log(denom) + mx_all - tgt_logit
+        # LOCAL mean; the cross-(data,seq) mean happens on the gradients
+        return jnp.mean(nll)
+
+    in_specs = (specs, P("data", "seq"), P("data", "seq"))
+
+    def step(params, tokens, targets):
+        loss, grads = jax.value_and_grad(
+            lambda p: local_fwd(p, tokens, targets))(params)
+        # DP/SP gradient all-reduce — the in-graph kvstore push/pull
+        # (SURVEY §5.8: CommDevice reduce ≡ psum over ICI)
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.pmean(g, ("data", "seq")), grads)
+        # embed's cotangent only reaches pipe rank 0 (the pipeline ingests
+        # x there); psum makes it whole. unembed/lnf grads are computed
+        # identically on every pipe rank (post-broadcast graph) — no-op.
+        grads["embed"] = jax.lax.psum(grads["embed"], "pipe")
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: (p - lr * g).astype(p.dtype), params, grads)
+        loss = jax.lax.pmean(loss, ("data", "seq"))
+        return loss, new_params
+
+    smapped = shard_map(
+        step, mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(P(), specs),
+        check_rep=False)
+    return jax.jit(smapped)
+
+
+def shard_params(params, mesh: Mesh, cfg: TransformerConfig):
+    specs = param_specs(cfg)
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}

@@ -1,0 +1,103 @@
+"""Parallel subsystem tests on the 8-device virtual CPU mesh — the
+analogue of the reference's multi-device-without-hardware strategy
+(SURVEY §4.3, tests/python/unittest/test_multi_device_exec.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mxnet_tpu.parallel import (MeshConfig, auto_mesh, make_mesh,
+                                collectives, ring_attention, pipeline,
+                                transformer)
+from mxnet_tpu.ops.attention import dot_product_attention
+
+
+def test_auto_mesh_factorization():
+    mesh = auto_mesh(8)
+    assert dict(mesh.shape) == {"data": 1, "seq": 2, "pipe": 2, "model": 2}
+    mesh = auto_mesh(4)
+    assert dict(mesh.shape) == {"data": 1, "seq": 1, "pipe": 2, "model": 2}
+
+
+def test_mesh_all_reduce_and_bandwidth():
+    mesh = make_mesh(MeshConfig(data=8))
+    # one contribution slot per device, as kvstore push receives them
+    x = jnp.stack([jnp.full((16,), float(i)) for i in range(8)])
+    out = collectives.mesh_all_reduce(x, mesh, "data")
+    np.testing.assert_allclose(np.asarray(out), np.full(16, 28.0))
+    bw = collectives.bus_bandwidth(mesh, size_mb=1.0, iters=2)
+    assert bw > 0
+
+
+def test_ring_attention_matches_reference():
+    mesh = make_mesh(MeshConfig(seq=4, data=2))
+    b, h, t, d = 2, 2, 16, 8
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+    for causal in (False, True):
+        out = ring_attention.ring_attention(q, k, v, mesh, causal=causal)
+        ref = dot_product_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_spmd_pipeline_matches_sequential():
+    mesh = make_mesh(MeshConfig(pipe=4, data=2))
+    n_stages, mb_all, dim = 4, 8, 16
+    rng = np.random.RandomState(1)
+    w = jnp.asarray(rng.randn(n_stages, dim, dim) * 0.3, jnp.float32)
+    x = jnp.asarray(rng.randn(mb_all, dim), jnp.float32)
+
+    def stage_fn(wi, h):
+        return jnp.tanh(h @ wi["w"])
+
+    out = pipeline.spmd_pipeline(stage_fn, {"w": w}, x, mesh, n_micro=4)
+    ref = x
+    for i in range(n_stages):
+        ref = jnp.tanh(ref @ w[i])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sharded_transformer_step_runs_and_matches_single_device():
+    cfg = transformer.TransformerConfig(
+        vocab=32, dm=16, heads=4, dff=32, layers_per_stage=1, seq_len=8)
+    mesh = make_mesh(MeshConfig(data=1, seq=2, pipe=2, model=2))
+    n_stages = mesh.shape["pipe"]
+    params = transformer.init_params(cfg, n_stages)
+    sharded = transformer.shard_params(params, mesh, cfg)
+    step = transformer.make_train_step(mesh, cfg, n_micro=2, lr=0.1)
+
+    rng = np.random.RandomState(2)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab, (4, cfg.seq_len)))
+    targets = jnp.asarray(rng.randint(0, cfg.vocab, (4, cfg.seq_len)))
+    loss1, p1 = step(sharded, tokens, targets)
+    loss2, _ = step(p1, tokens, targets)
+    assert float(loss2) < float(loss1)  # one SGD step reduces loss
+
+    # cross-check the sharded loss against a plain single-device forward
+    ref_loss = _reference_loss(params, tokens, targets, cfg, n_stages)
+    np.testing.assert_allclose(float(loss1), ref_loss, rtol=1e-4)
+
+
+def _reference_loss(params, tokens, targets, cfg, n_stages):
+    x = jnp.take(params["embed"], tokens, axis=0)
+    dh = cfg.dm // cfg.heads
+    for s in range(n_stages):
+        for li in range(cfg.layers_per_stage):
+            h = transformer._ln(x, params["ln1"][s, li])
+            qkv = h @ params["wqkv"][s, li]
+            b, t, _ = qkv.shape
+            qkv = qkv.reshape(b, t, cfg.heads, 3, dh).transpose(3, 0, 2, 1, 4)
+            att = dot_product_attention(qkv[0], qkv[1], qkv[2], causal=True)
+            att = att.transpose(0, 2, 1, 3).reshape(b, t, cfg.dm)
+            x = x + att @ params["wo"][s, li]
+            h = transformer._ln(x, params["ln2"][s, li])
+            x = x + jax.nn.gelu(h @ params["w1"][s, li]) @ params["w2"][s, li]
+    x = transformer._ln(x, params["lnf"])
+    logits = x @ params["unembed"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return float(jnp.mean(nll))
