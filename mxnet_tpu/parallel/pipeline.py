@@ -77,6 +77,6 @@ def spmd_pipeline(stage_fn, params, x, mesh: Mesh, n_micro: int,
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis), params)
     fn = shard_map(body, mesh=mesh, in_specs=(pspec, P()), out_specs=P(),
-                   check_rep=False)
+                   check_vma=False)
     out = fn(params, x_mb)
     return out.reshape((b,) + out.shape[2:])

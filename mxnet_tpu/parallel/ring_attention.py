@@ -97,5 +97,5 @@ def ring_attention(q, k, v, mesh: Mesh, causal=True, seq_axis="seq"):
                              causal=causal, chunk=t // n)
     spec = P(None, None, seq_axis, None)
     fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                   out_specs=spec, check_rep=False)
+                   out_specs=spec, check_vma=False)
     return fn(q, k, v)

@@ -193,7 +193,7 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, n_micro: int = None,
         step, mesh=mesh,
         in_specs=in_specs,
         out_specs=(P(), specs),
-        check_rep=False)
+        check_vma=False)
     return jax.jit(smapped)
 
 
