@@ -1,0 +1,96 @@
+"""Benchmark: ResNet-50 training throughput, single chip, batch 32 —
+the reference's headline number (docs/how_to/perf.md:179-188,
+train_imagenet.py): P100 = 181.53 img/s. vs_baseline = ours / 181.53.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Design: the whole training step is TWO jitted XLA computations — fused
+forward+backward from the symbolic graph (executor._get_fwd_bwd; the
+reference's bulk-exec segments collapsed into one compilation, SURVEY §7)
+and one whole-tree fused SGD-momentum update (the reference's per-weight
+sgd_mom_update kernels batched into a single program).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BATCH = int(os.environ.get("BENCH_BATCH", "32"))
+BASELINE = 181.53  # P100 ResNet-50 training img/s
+WARMUP = 3
+ITERS = int(os.environ.get("BENCH_ITERS", "20"))
+
+
+def main():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+
+    sym = models.get_symbol("resnet-50", num_classes=1000)
+    data_shape = (BATCH, 3, 224, 224)
+    exe = sym.simple_bind(mx.Context("tpu", 0) if jax.default_backend() != "cpu"
+                          else mx.cpu(), grad_req="write",
+                          data=data_shape, softmax_label=(BATCH,))
+    # init weights
+    init = mx.initializer.Xavier(factor_type="in", magnitude=2.0)
+    for name, arr in exe.arg_dict.items():
+        if name in ("data", "softmax_label"):
+            continue
+        init(mx.initializer.InitDesc(name), arr)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.uniform(-1, 1, data_shape).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 1000, (BATCH,)).astype(np.float32))
+
+    lr, momentum, wd = 0.05, 0.9, 1e-4
+    param_names = [n for n in exe.arg_dict if n not in ("data", "softmax_label")]
+
+    @jax.jit
+    def sgd_all(params, grads, moms):
+        new_p, new_m = {}, {}
+        for n in params:
+            g = grads[n] + wd * params[n]
+            m = momentum * moms[n] - lr * g
+            new_p[n] = params[n] + m
+            new_m[n] = m
+        return new_p, new_m
+
+    moms = {n: jnp.zeros_like(exe.arg_dict[n]._data) for n in param_names}
+
+    def step():
+        exe.arg_dict["data"]._data = x
+        exe.arg_dict["softmax_label"]._data = y
+        exe.forward_backward()
+        params = {n: exe.arg_dict[n]._data for n in param_names}
+        grads = {n: exe.grad_dict[n]._data for n in param_names}
+        new_p, new_m = sgd_all(params, grads, moms)
+        for n in param_names:
+            exe.arg_dict[n]._data = new_p[n]
+            moms[n] = new_m[n]
+        return exe.outputs[0]
+
+    for _ in range(WARMUP):
+        out = step()
+    jax.block_until_ready(out._data if hasattr(out, "_data") else out)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = step()
+    jax.block_until_ready(out._data if hasattr(out, "_data") else out)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_bs%d" % BATCH,
+        "value": round(imgs_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(imgs_per_sec / BASELINE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
