@@ -1,0 +1,280 @@
+"""mx.image — Python-side image pipeline.
+
+Reimplementation of python/mxnet/image.py (SURVEY §2.4): composable
+augmenters + ImageIter reading .rec files or image lists, decoding with
+cv2 on the host. This is the flexible Python alternative to the native
+C++ pipeline (io_iters.ImageRecordIter / native/recordio.cc), exactly as
+the reference offers both (image.py:669 vs iter_image_recordio_2.cc).
+"""
+from __future__ import annotations
+
+import os
+import random as pyrandom
+from typing import List, Optional
+
+import numpy as np
+
+from . import ndarray as nd
+from . import recordio
+from .base import MXNetError
+from .io import DataBatch, DataDesc, DataIter
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    """Decode an image byte buffer to an NDArray (H, W, C) uint8
+    (reference image.py imdecode → src/io/image_io.cc)."""
+    import cv2
+
+    img = cv2.imdecode(np.frombuffer(buf, np.uint8),
+                       cv2.IMREAD_COLOR if flag else cv2.IMREAD_GRAYSCALE)
+    if img is None:
+        raise MXNetError("imdecode failed")
+    if flag and to_rgb:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    return nd.array(img)
+
+
+def imresize(src, w, h, interp=1):
+    import cv2
+
+    arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    return nd.array(cv2.resize(arr, (w, h), interpolation=interp))
+
+
+def scale_down(src_size, size):
+    """Scale size down to fit in src_size keeping aspect (reference
+    image.py scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize shorter edge to `size` (reference image.py resize_short)."""
+    import cv2
+
+    arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    h, w = arr.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return nd.array(cv2.resize(arr, (new_w, new_h), interpolation=interp))
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    out = arr[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        import cv2
+
+        out = cv2.resize(out, size, interpolation=interp)
+    return nd.array(out)
+
+
+def random_crop(src, size, interp=2):
+    arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    arr = (src.asnumpy() if hasattr(src, "asnumpy")
+           else np.asarray(src)).astype(np.float32)
+    arr = arr - np.asarray(mean)
+    if std is not None:
+        arr = arr / np.asarray(std)
+    return nd.array(arr)
+
+
+# --- composable augmenters (reference image.py CreateAugmenter) -----------
+class Augmenter:
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return nd.array(np.ascontiguousarray(src.asnumpy()[:, ::-1]))
+        return src
+
+
+class CastAug(Augmenter):
+    def __call__(self, src):
+        return nd.array(src.asnumpy().astype(np.float32))
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        self.mean, self.std = mean, std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return nd.array(src.asnumpy().astype(np.float32) * alpha)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, inter_method=2):
+    """Build the standard augmenter list (reference image.py
+    CreateAugmenter)."""
+    auglist: List[Augmenter] = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness:
+        auglist.append(BrightnessJitterAug(brightness))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Flexible Python image iterator over .rec or image-list files
+    (reference image.py ImageIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 shuffle=False, aug_list=None, imglist=None, **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(self.data_shape, **kwargs)
+        self.shuffle = shuffle
+        self._rec = None
+        self.imglist = []
+        if path_imgrec:
+            self._rec = recordio.MXRecordIO(path_imgrec, "r")
+        elif path_imglist:
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    label = np.array(parts[1:1 + label_width], np.float32)
+                    self.imglist.append((label, os.path.join(path_root,
+                                                             parts[-1])))
+        elif imglist:
+            for label, fname in imglist:
+                self.imglist.append((np.array(label, np.float32).reshape(-1),
+                                     os.path.join(path_root, fname)))
+        else:
+            raise MXNetError("need path_imgrec, path_imglist, or imglist")
+        self._order = list(range(len(self.imglist))) if self.imglist else None
+        self._cursor = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self._cursor = 0
+        if self._rec is not None:
+            self._rec.reset()
+        elif self.shuffle:
+            pyrandom.shuffle(self._order)
+
+    def next_sample(self):
+        if self._rec is not None:
+            buf = self._rec.read()
+            if buf is None:
+                raise StopIteration
+            header, img = recordio.unpack(buf)
+            lab = header.label
+            return np.asarray(lab, np.float32).reshape(-1), img
+        if self._cursor >= len(self.imglist):
+            raise StopIteration
+        label, fname = self.imglist[self._order[self._cursor]]
+        self._cursor += 1
+        with open(fname, "rb") as f:
+            return label, f.read()
+
+    def next(self):
+        c, h, w = self.data_shape
+        batch_data = np.zeros((self.batch_size, c, h, w), np.float32)
+        batch_label = np.zeros((self.batch_size,), np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                label, buf = self.next_sample()
+                img = imdecode(buf)
+                for aug in self.auglist:
+                    img = aug(img)
+                arr = img.asnumpy()
+                batch_data[i] = arr.transpose(2, 0, 1)
+                batch_label[i] = label.flat[0]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        return DataBatch([nd.array(batch_data)], [nd.array(batch_label)],
+                         pad=self.batch_size - i)

@@ -364,3 +364,8 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+
+# Registered iterators (reference MXNET_REGISTER_IO_ITER classes) live in
+# io_iters.py; re-exported here so callers use mx.io.ImageRecordIter etc.
+from .io_iters import ImageRecordIter, CSVIter, MNISTIter  # noqa: E402,F401

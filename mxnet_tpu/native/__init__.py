@@ -1,0 +1,157 @@
+"""ctypes bindings for the native data-plane library (native/recordio.cc).
+
+The flat-C-ABI + ctypes boundary mirrors the reference's C API discipline
+(include/mxnet/c_api.h ↔ python/mxnet/base.py ctypes loading). The library
+is built on demand with `make -C native`; all callers degrade to the pure-
+Python path when the toolchain or libjpeg is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SO = os.path.join(_REPO, "native", "libmxtpu_io.so")
+
+_lib = None
+_tried = False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_SO):
+        try:
+            subprocess.run(["make", "-C", os.path.dirname(_SO)], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    lib.mxio_reader_open.restype = ctypes.c_void_p
+    lib.mxio_reader_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.mxio_reader_next.restype = ctypes.c_int
+    lib.mxio_reader_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.mxio_reader_reset.argtypes = [ctypes.c_void_p]
+    lib.mxio_reader_close.argtypes = [ctypes.c_void_p]
+    lib.mxio_writer_open.restype = ctypes.c_void_p
+    lib.mxio_writer_open.argtypes = [ctypes.c_char_p]
+    lib.mxio_writer_write.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_uint8),
+                                      ctypes.c_uint64]
+    lib.mxio_writer_close.argtypes = [ctypes.c_void_p]
+    lib.mxio_imgloader_create.restype = ctypes.c_void_p
+    lib.mxio_imgloader_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+        ctypes.c_int]
+    lib.mxio_imgloader_next.restype = ctypes.c_int
+    lib.mxio_imgloader_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float)]
+    lib.mxio_imgloader_reset.argtypes = [ctypes.c_void_p]
+    lib.mxio_imgloader_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+class NativeRecordReader:
+    """Sharded sequential reader over a .rec file (native)."""
+
+    def __init__(self, path, part_index=0, num_parts=1):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native io library unavailable")
+        self._lib = lib
+        self._h = lib.mxio_reader_open(path.encode(), part_index, num_parts)
+        if not self._h:
+            raise IOError("cannot open %s" % path)
+
+    def read(self):
+        data = ctypes.POINTER(ctypes.c_uint8)()
+        length = ctypes.c_uint64()
+        if not self._lib.mxio_reader_next(self._h, ctypes.byref(data),
+                                          ctypes.byref(length)):
+            return None
+        return ctypes.string_at(data, length.value)
+
+    def reset(self):
+        self._lib.mxio_reader_reset(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.mxio_reader_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeImageLoader:
+    """Threaded JPEG-decoding batch loader (native ImageRecordIOParser2
+    analogue). Yields (data (N,C,H,W) float32, labels (N,), n_valid)."""
+
+    def __init__(self, path, batch_size, data_shape, nthreads=4,
+                 rand_crop=False, rand_mirror=False, mean_rgb=None,
+                 std_rgb=None, part_index=0, num_parts=1, seed=0,
+                 resize_shorter=0, queue_depth=2):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native io library unavailable")
+        self._lib = lib
+        c, h, w = data_shape
+        mean = (ctypes.c_float * 3)(*(mean_rgb or (0.0, 0.0, 0.0)))
+        std = (ctypes.c_float * 3)(*(std_rgb or (1.0, 1.0, 1.0)))
+        self.batch_size = batch_size
+        self.data_shape = data_shape
+        self._data = np.empty((batch_size, c, h, w), np.float32)
+        self._labels = np.empty((batch_size,), np.float32)
+        self._h = lib.mxio_imgloader_create(
+            path.encode(), batch_size, h, w, c, nthreads,
+            int(rand_crop), int(rand_mirror), mean, std,
+            part_index, num_parts, seed, resize_shorter, queue_depth)
+        if not self._h:
+            raise IOError("cannot open %s" % path)
+
+    def next_batch(self):
+        n = self._lib.mxio_imgloader_next(
+            self._h,
+            self._data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if n == 0:
+            return None
+        return self._data, self._labels, n
+
+    def reset(self):
+        self._lib.mxio_imgloader_reset(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.mxio_imgloader_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,433 @@
+// Native data plane: RecordIO + threaded image batch loader.
+//
+// TPU-native equivalent of the reference's C++ input pipeline
+// (src/io/iter_image_recordio_2.cc ImageRecordIOParser2 + iter_prefetcher.h
+// PrefetcherIter + dmlc-core recordio/InputSplit, SURVEY SS2.1 #27, SS3.5):
+// a producer thread streams framed records off disk (sharded part k of n
+// for multi-host input splits), a pool of decoder threads JPEG-decodes and
+// augments straight into preallocated float32 NCHW batch buffers, and
+// finished batches hand off through a bounded queue (double buffering) so
+// host IO overlaps device compute. Exposed as a flat C ABI for ctypes
+// (mxnet_tpu/native/__init__.py) -- same boundary discipline as the
+// reference's C API (include/mxnet/c_api.h).
+//
+// Record framing matches mxnet_tpu/recordio.py (and the reference
+// dmlc recordio): [kMagic u32][cflag<<29|len u32][payload][pad4].
+// Image payload: IRHeader{u32 flag; f32 label; u64 id,id2}
+//                [flag>1 ? flag*f32 labels] [jpeg bytes].
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+#include <cmath>
+#include <atomic>
+#include <condition_variable>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <jpeglib.h>
+#include <setjmp.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+// ---------------------------------------------------------------- reader --
+struct Reader {
+  FILE* fp = nullptr;
+  int part = 0, nparts = 1;
+  uint64_t rec_idx = 0;
+  std::vector<uint8_t> buf;
+
+  bool NextRaw() {  // read one framed record into buf
+    uint32_t head[2];
+    if (fread(head, 4, 2, fp) != 2) return false;
+    if (head[0] != kMagic) return false;
+    uint32_t len = head[1] & ((1u << 29) - 1);
+    buf.resize(len);
+    if (len && fread(buf.data(), 1, len, fp) != len) return false;
+    uint32_t pad = (4 - len % 4) % 4;
+    if (pad) fseek(fp, pad, SEEK_CUR);
+    return true;
+  }
+
+  bool Next() {  // sharded: keep records where idx % nparts == part
+    while (NextRaw()) {
+      bool mine = (rec_idx % (uint64_t)nparts) == (uint64_t)part;
+      ++rec_idx;
+      if (mine) return true;
+    }
+    return false;
+  }
+
+  void Reset() {
+    fseek(fp, 0, SEEK_SET);
+    rec_idx = 0;
+  }
+};
+
+// ---------------------------------------------------------------- writer --
+struct Writer {
+  FILE* fp = nullptr;
+  void Write(const uint8_t* data, uint64_t len) {
+    uint32_t head[2] = {kMagic, (uint32_t)(len & ((1u << 29) - 1))};
+    fwrite(head, 4, 2, fp);
+    fwrite(data, 1, len, fp);
+    uint32_t pad = (4 - len % 4) % 4;
+    uint32_t zero = 0;
+    if (pad) fwrite(&zero, 1, pad, fp);
+  }
+};
+
+// ----------------------------------------------------------- jpeg decode --
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  JpegErr* e = (JpegErr*)cinfo->err;
+  longjmp(e->jb, 1);
+}
+
+// decode to RGB; returns false on corrupt input
+bool DecodeJpeg(const uint8_t* data, size_t len, std::vector<uint8_t>* out,
+                int* w, int* h) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data), len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  out->resize((size_t)(*w) * (*h) * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data() + (size_t)cinfo.output_scanline * (*w) * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// bilinear resize RGB u8
+void Resize(const std::vector<uint8_t>& src, int sw, int sh,
+            std::vector<uint8_t>* dst, int dw, int dh) {
+  dst->resize((size_t)dw * dh * 3);
+  float sx = (float)sw / dw, sy = (float)sh / dh;
+  for (int y = 0; y < dh; ++y) {
+    float fy = (y + 0.5f) * sy - 0.5f;
+    int y0 = std::max(0, (int)fy), y1 = std::min(sh - 1, y0 + 1);
+    float wy = fy - y0;
+    if (wy < 0) wy = 0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = (x + 0.5f) * sx - 0.5f;
+      int x0 = std::max(0, (int)fx), x1 = std::min(sw - 1, x0 + 1);
+      float wx = fx - x0;
+      if (wx < 0) wx = 0;
+      for (int c = 0; c < 3; ++c) {
+        float v00 = src[((size_t)y0 * sw + x0) * 3 + c];
+        float v01 = src[((size_t)y0 * sw + x1) * 3 + c];
+        float v10 = src[((size_t)y1 * sw + x0) * 3 + c];
+        float v11 = src[((size_t)y1 * sw + x1) * 3 + c];
+        float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                  v10 * wy * (1 - wx) + v11 * wy * wx;
+        (*dst)[((size_t)y * dw + x) * 3 + c] = (uint8_t)(v + 0.5f);
+      }
+    }
+  }
+}
+
+// ------------------------------------------------------------ img loader --
+struct LoaderCfg {
+  int batch, H, W, C;
+  int rand_crop, rand_mirror;
+  float mean[3], std[3];
+  int resize_shorter;  // 0 = resize directly to HxW
+};
+
+struct Batch {
+  std::vector<float> data;    // batch*C*H*W
+  std::vector<float> labels;  // batch
+  int n = 0;
+};
+
+struct ImgLoader {
+  LoaderCfg cfg;
+  Reader reader;
+  int nthreads;
+  uint64_t seed;
+
+  std::mutex mu;
+  std::condition_variable cv_full, cv_free;
+  std::queue<Batch*> ready;
+  std::queue<Batch*> free_pool;
+  std::vector<Batch> storage;
+  std::thread producer;
+  std::atomic<bool> stop{false};
+  std::atomic<bool> eof{false};
+
+  // one record's (payload copy) work item
+  struct Work {
+    std::vector<uint8_t> rec;
+    int slot;
+  };
+
+  void DecodeInto(const Work& w, Batch* b, std::mt19937* rng) {
+    const uint8_t* p = w.rec.data();
+    size_t len = w.rec.size();
+    if (len < 24) return;
+    uint32_t flag;
+    float label;
+    memcpy(&flag, p, 4);
+    memcpy(&label, p + 4, 4);
+    size_t off = 24 + (flag > 1 ? (size_t)flag * 4 : 0);
+    if (off >= len) return;
+    int w0, h0;
+    std::vector<uint8_t> rgb, resized;
+    if (!DecodeJpeg(p + off, len - off, &rgb, &w0, &h0)) return;
+
+    const LoaderCfg& c = cfg;
+    int cw = c.W, ch = c.H;
+    const std::vector<uint8_t>* src = &rgb;
+    int sw = w0, sh = h0;
+    if (c.resize_shorter > 0) {
+      int shorter = std::min(w0, h0);
+      float scale = (float)c.resize_shorter / shorter;
+      int nw = (int)(w0 * scale + 0.5f), nh = (int)(h0 * scale + 0.5f);
+      Resize(rgb, w0, h0, &resized, nw, nh);
+      src = &resized;
+      sw = nw;
+      sh = nh;
+    } else if (w0 != cw || h0 != ch) {
+      Resize(rgb, w0, h0, &resized, cw, ch);
+      src = &resized;
+      sw = cw;
+      sh = ch;
+    }
+    // crop
+    int x0 = (sw - cw) / 2, y0 = (sh - ch) / 2;
+    if (c.rand_crop && sw > cw) x0 = (int)((*rng)() % (uint32_t)(sw - cw + 1));
+    if (c.rand_crop && sh > ch) y0 = (int)((*rng)() % (uint32_t)(sh - ch + 1));
+    x0 = std::max(0, x0);
+    y0 = std::max(0, y0);
+    bool mirror = c.rand_mirror && ((*rng)() & 1);
+
+    float* dst = b->data.data() + (size_t)w.slot * c.C * ch * cw;
+    for (int cc = 0; cc < c.C; ++cc) {
+      for (int y = 0; y < ch; ++y) {
+        for (int x = 0; x < cw; ++x) {
+          int sxp = mirror ? (cw - 1 - x) : x;
+          int yy = std::min(sh - 1, y0 + y), xx = std::min(sw - 1, x0 + sxp);
+          float v = (*src)[((size_t)yy * sw + xx) * 3 + cc];
+          dst[((size_t)cc * ch + y) * cw + x] = (v - c.mean[cc]) / c.std[cc];
+        }
+      }
+    }
+    b->labels[w.slot] = label;
+  }
+
+  void ProducerLoop() {
+    std::vector<Work> works(cfg.batch);
+    while (!stop.load()) {
+      // grab a free batch buffer
+      Batch* b;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_free.wait(lk, [&] { return stop.load() || !free_pool.empty(); });
+        if (stop.load()) return;
+        b = free_pool.front();
+        free_pool.pop();
+      }
+      // read batch-many records (single-threaded IO, parallel decode)
+      int n = 0;
+      for (; n < cfg.batch; ++n) {
+        if (!reader.Next()) break;
+        works[n].rec = reader.buf;
+        works[n].slot = n;
+      }
+      if (n == 0) {
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          free_pool.push(b);
+          eof.store(true);
+          ready.push(nullptr);  // EOF sentinel
+        }
+        cv_full.notify_all();
+        return;
+      }
+      b->n = n;
+      // parallel decode
+      std::atomic<int> next{0};
+      auto decode_fn = [&](uint64_t tid) {
+        std::mt19937 rng((uint32_t)(seed + tid * 9973 + reader.rec_idx));
+        int i;
+        while ((i = next.fetch_add(1)) < n) DecodeInto(works[i], b, &rng);
+      };
+      if (nthreads <= 1) {
+        decode_fn(0);
+      } else {
+        std::vector<std::thread> ts;
+        for (int t = 0; t < nthreads; ++t) ts.emplace_back(decode_fn, t);
+        for (auto& t : ts) t.join();
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        ready.push(b);
+      }
+      cv_full.notify_all();
+    }
+  }
+
+  void Start() {
+    stop.store(false);
+    eof.store(false);
+    producer = std::thread([this] { ProducerLoop(); });
+  }
+
+  void Stop() {
+    stop.store(true);
+    cv_free.notify_all();
+    cv_full.notify_all();
+    if (producer.joinable()) producer.join();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- reader ----
+void* mxio_reader_open(const char* path, int part, int nparts) {
+  FILE* fp = fopen(path, "rb");
+  if (!fp) return nullptr;
+  Reader* r = new Reader();
+  r->fp = fp;
+  r->part = part;
+  r->nparts = nparts;
+  return r;
+}
+
+int mxio_reader_next(void* h, const uint8_t** data, uint64_t* len) {
+  Reader* r = (Reader*)h;
+  if (!r->Next()) return 0;
+  *data = r->buf.data();
+  *len = r->buf.size();
+  return 1;
+}
+
+void mxio_reader_reset(void* h) { ((Reader*)h)->Reset(); }
+
+void mxio_reader_close(void* h) {
+  Reader* r = (Reader*)h;
+  fclose(r->fp);
+  delete r;
+}
+
+// ---- writer ----
+void* mxio_writer_open(const char* path) {
+  FILE* fp = fopen(path, "wb");
+  if (!fp) return nullptr;
+  Writer* w = new Writer();
+  w->fp = fp;
+  return w;
+}
+
+void mxio_writer_write(void* h, const uint8_t* data, uint64_t len) {
+  ((Writer*)h)->Write(data, len);
+}
+
+void mxio_writer_close(void* h) {
+  Writer* w = (Writer*)h;
+  fclose(w->fp);
+  delete w;
+}
+
+// ---- threaded image loader ----
+void* mxio_imgloader_create(const char* path, int batch, int H, int W, int C,
+                            int nthreads, int rand_crop, int rand_mirror,
+                            const float* mean_rgb, const float* std_rgb,
+                            int part, int nparts, uint64_t seed,
+                            int resize_shorter, int queue_depth) {
+  FILE* fp = fopen(path, "rb");
+  if (!fp) return nullptr;
+  ImgLoader* L = new ImgLoader();
+  L->reader.fp = fp;
+  L->reader.part = part;
+  L->reader.nparts = nparts;
+  L->cfg = LoaderCfg{batch, H, W, C, rand_crop, rand_mirror,
+                     {0, 0, 0}, {1, 1, 1}, resize_shorter};
+  for (int i = 0; i < 3; ++i) {
+    if (mean_rgb) L->cfg.mean[i] = mean_rgb[i];
+    if (std_rgb) L->cfg.std[i] = std_rgb[i];
+  }
+  L->nthreads = nthreads;
+  L->seed = seed;
+  if (queue_depth < 2) queue_depth = 2;
+  L->storage.resize(queue_depth);
+  for (auto& b : L->storage) {
+    b.data.resize((size_t)batch * C * H * W);
+    b.labels.resize(batch);
+    L->free_pool.push(&b);
+  }
+  L->Start();
+  return L;
+}
+
+int mxio_imgloader_next(void* h, float* data, float* labels) {
+  ImgLoader* L = (ImgLoader*)h;
+  Batch* b;
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->cv_full.wait(lk, [&] { return !L->ready.empty(); });
+    b = L->ready.front();
+    L->ready.pop();
+  }
+  if (b == nullptr) return 0;  // EOF
+  memcpy(data, b->data.data(), b->data.size() * 4);
+  memcpy(labels, b->labels.data(), b->labels.size() * 4);
+  int n = b->n;
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->free_pool.push(b);
+  }
+  L->cv_free.notify_one();
+  return n;
+}
+
+void mxio_imgloader_reset(void* h) {
+  ImgLoader* L = (ImgLoader*)h;
+  L->Stop();
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    while (!L->ready.empty()) {
+      Batch* b = L->ready.front();
+      L->ready.pop();
+      if (b) L->free_pool.push(b);
+    }
+  }
+  L->reader.Reset();
+  L->Start();
+}
+
+void mxio_imgloader_destroy(void* h) {
+  ImgLoader* L = (ImgLoader*)h;
+  L->Stop();
+  fclose(L->reader.fp);
+  delete L;
+}
+
+}  // extern "C"

