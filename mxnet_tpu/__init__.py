@@ -34,6 +34,7 @@ from . import visualization as viz
 from . import test_utils
 from . import model
 from .model import FeedForward
+from . import operator
 from . import recordio
 from . import rnn
 from . import profiler

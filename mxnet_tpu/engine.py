@@ -1,0 +1,277 @@
+"""Host-side dependency engine.
+
+Python surface over the native scheduler (native/engine.cc) — the
+TPU-native counterpart of the reference's Engine singleton
+(include/mxnet/engine.h:75-250, src/engine/threaded_engine*.cc,
+SURVEY §2.1 #1-5).
+
+Division of labor (SURVEY §7): *device* work is ordered by XLA's async
+runtime — jax.Array dispatch is already the reference NDArray's
+engine-var pipelining (`.block_until_ready()` ≡ WaitToRead). This engine
+orders the HOST work XLA cannot see: checkpoint/file IO, data-pipeline
+stages, parameter-server-style updates, metric sinks. Semantics are the
+reference's: closures tagged with const (read) / mutable (write) variable
+sets; conflicting ops serialize in push order, independent ops run
+concurrently on a native worker pool.
+
+Selection mirrors MXNET_ENGINE_TYPE (src/engine/engine.cc:13-38):
+``ThreadedEngine`` (default) or ``NaiveEngine`` (fully synchronous, for
+debugging — the reference's own advice, threaded_engine.h:326-338).
+
+    from mxnet_tpu import engine
+    v = engine.new_variable()
+    engine.push(lambda: write_file(...), mutable_vars=[v])
+    engine.push(lambda: read_file(...), const_vars=[v])   # ordered after
+    engine.wait_for_all()
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import threading
+import traceback
+from typing import Callable, Dict, Optional, Sequence
+
+from .base import MXNetError
+
+_OPR_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p)
+_DEL_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+def _load_native() -> Optional[ctypes.CDLL]:
+    from . import native as _native
+
+    # reuse the shared build machinery; the engine lib sits next to the io lib
+    so = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                      "native", "libmxtpu_engine.so")
+    if not os.path.exists(so):
+        try:
+            import subprocess
+
+            subprocess.run(["make", "-C", os.path.dirname(so),
+                            "libmxtpu_engine.so"], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    lib.mxe_create.restype = ctypes.c_void_p
+    lib.mxe_create.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.mxe_destroy.argtypes = [ctypes.c_void_p]
+    lib.mxe_new_var.restype = ctypes.c_int64
+    lib.mxe_new_var.argtypes = [ctypes.c_void_p]
+    lib.mxe_delete_var.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.mxe_push.argtypes = [
+        ctypes.c_void_p, _OPR_FN, ctypes.c_void_p, _DEL_FN,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    lib.mxe_opr_complete.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.mxe_wait_for_var.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.mxe_wait_for_all.argtypes = [ctypes.c_void_p]
+    lib.mxe_pending.restype = ctypes.c_int
+    lib.mxe_pending.argtypes = [ctypes.c_void_p]
+    lib.mxe_set_profiling.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.mxe_dump_profile.restype = ctypes.c_int64
+    lib.mxe_dump_profile.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int64]
+    return lib
+
+
+class NativeEngine:
+    """ctypes wrapper over native/engine.cc."""
+
+    def __init__(self, num_workers=0, engine_type="ThreadedEngine"):
+        self._lib = _load_native()
+        if self._lib is None:
+            raise MXNetError("native engine library unavailable")
+        etype = 1 if engine_type == "NaiveEngine" else 0
+        self._h = self._lib.mxe_create(num_workers, etype)
+        self._pending: Dict[int, tuple] = {}
+        self._pending_lock = threading.Lock()
+        self._next_key = [1]
+        # single C trampoline for every op; param = key into _pending
+        self._trampoline = _OPR_FN(self._dispatch)
+        self._no_del = ctypes.cast(None, _DEL_FN)
+
+    def _dispatch(self, param, on_complete):
+        key = int(param)
+        with self._pending_lock:
+            fn, is_async = self._pending.pop(key)
+        try:
+            if is_async:
+                h = ctypes.c_void_p(on_complete)
+
+                def complete(_h=h):
+                    self._lib.mxe_opr_complete(self._h, _h)
+
+                fn(complete)
+            else:
+                fn()
+        except Exception:  # never let an exception cross the C boundary
+            traceback.print_exc()
+            if is_async:
+                self._lib.mxe_opr_complete(self._h, ctypes.c_void_p(on_complete))
+
+    def new_variable(self) -> int:
+        return self._lib.mxe_new_var(self._h)
+
+    def delete_variable(self, var: int):
+        self._lib.mxe_delete_var(self._h, var)
+
+    def _push(self, fn, const_vars, mutable_vars, priority, name, is_async):
+        const_vars, mutable_vars = _dedup(const_vars, mutable_vars)
+        with self._pending_lock:
+            key = self._next_key[0]
+            self._next_key[0] += 1
+            self._pending[key] = (fn, is_async)
+        c = (ctypes.c_int64 * max(len(const_vars), 1))(*const_vars)
+        m = (ctypes.c_int64 * max(len(mutable_vars), 1))(*mutable_vars)
+        self._lib.mxe_push(self._h, self._trampoline, ctypes.c_void_p(key),
+                           self._no_del, c, len(const_vars), m,
+                           len(mutable_vars), priority, name.encode(),
+                           1 if is_async else 0)
+
+    def push(self, fn: Callable[[], None], const_vars: Sequence[int] = (),
+             mutable_vars: Sequence[int] = (), priority: int = 0,
+             name: str = "op"):
+        """PushSync (engine.h:198-208): fn runs on a worker; completion is
+        automatic on return."""
+        self._push(fn, const_vars, mutable_vars, priority, name, False)
+
+    def push_async(self, fn: Callable[[Callable[[], None]], None],
+                   const_vars: Sequence[int] = (),
+                   mutable_vars: Sequence[int] = (), priority: int = 0,
+                   name: str = "op"):
+        """PushAsync (engine.h:158-170): fn receives an ``on_complete``
+        callable it must invoke (from any thread) when the op finishes."""
+        self._push(fn, const_vars, mutable_vars, priority, name, True)
+
+    def wait_for_var(self, var: int):
+        self._lib.mxe_wait_for_var(self._h, var)
+
+    def wait_for_all(self):
+        self._lib.mxe_wait_for_all(self._h)
+
+    def pending(self) -> int:
+        return self._lib.mxe_pending(self._h)
+
+    def set_profiling(self, on: bool):
+        self._lib.mxe_set_profiling(self._h, int(on))
+
+    def dump_profile(self) -> dict:
+        n = self._lib.mxe_dump_profile(self._h, None, 0)
+        buf = ctypes.create_string_buffer(n + 16)
+        self._lib.mxe_dump_profile(self._h, buf, n + 16)
+        return json.loads(buf.value.decode())
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.mxe_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+class PythonEngine:
+    """Pure-Python fallback with identical semantics (a NaiveEngine that
+    still honors the API — everything runs inline, like naive_engine.cc)."""
+
+    def __init__(self, num_workers=0, engine_type="NaiveEngine"):
+        self._next = 1
+        self._prof = []
+        self._profiling = False
+
+    def new_variable(self):
+        self._next += 1
+        return self._next - 1
+
+    def delete_variable(self, var):
+        pass
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0, name="op"):
+        import time
+
+        t0 = time.time()
+        fn()
+        if self._profiling:
+            self._prof.append({"name": name, "ph": "X", "pid": 0, "tid": 0,
+                               "ts": int(t0 * 1e6),
+                               "dur": int((time.time() - t0) * 1e6)})
+
+    def push_async(self, fn, const_vars=(), mutable_vars=(), priority=0,
+                   name="op"):
+        done = threading.Event()
+        fn(done.set)
+        done.wait()
+
+    def wait_for_var(self, var):
+        pass
+
+    def wait_for_all(self):
+        pass
+
+    def pending(self):
+        return 0
+
+    def set_profiling(self, on):
+        self._profiling = bool(on)
+
+    def dump_profile(self):
+        return {"traceEvents": list(self._prof)}
+
+
+def _dedup(const_vars, mutable_vars):
+    """DeduplicateVarHandle (engine.h:231-249): drop repeats; a var that is
+    both read and mutated is tracked as mutable only."""
+    mut = list(dict.fromkeys(mutable_vars))
+    mset = set(mut)
+    const = [v for v in dict.fromkeys(const_vars) if v not in mset]
+    return const, mut
+
+
+_engine = None
+_engine_lock = threading.Lock()
+
+
+def get() -> "NativeEngine | PythonEngine":
+    """Engine.Get() singleton (engine.h:211). Type from MXNET_ENGINE_TYPE."""
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            etype = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEngine")
+            workers = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS", "0"))
+            try:
+                _engine = NativeEngine(workers, etype)
+            except MXNetError:
+                _engine = PythonEngine(workers, etype)
+        return _engine
+
+
+# module-level conveniences mirroring the reference's C API surface
+def new_variable():
+    return get().new_variable()
+
+
+def delete_variable(var):
+    get().delete_variable(var)
+
+
+def push(fn, const_vars=(), mutable_vars=(), priority=0, name="op"):
+    get().push(fn, const_vars, mutable_vars, priority, name)
+
+
+def push_async(fn, const_vars=(), mutable_vars=(), priority=0, name="op"):
+    get().push_async(fn, const_vars, mutable_vars, priority, name)
+
+
+def wait_for_var(var):
+    get().wait_for_var(var)
+
+
+def wait_for_all():
+    get().wait_for_all()

@@ -51,12 +51,14 @@ class ImageRecordIter(DataIter):
                 mean_rgb=(mean_r, mean_g, mean_b),
                 std_rgb=(std_r, std_g, std_b),
                 part_index=part_index, num_parts=num_parts, seed=seed,
-                resize_shorter=resize, queue_depth=prefetch_buffer)
+                resize_shorter=resize, queue_depth=prefetch_buffer,
+                shuffle_buffer=(max(4 * batch_size, 2048) if shuffle else 0))
         except Exception:
             self._py_fallback = _PyImageRecordReader(
                 path_imgrec, self.data_shape, rand_crop, rand_mirror,
                 (mean_r, mean_g, mean_b), (std_r, std_g, std_b), resize,
-                part_index, num_parts, seed)
+                part_index, num_parts, seed,
+                shuffle_buffer=(max(4 * batch_size, 2048) if shuffle else 0))
 
     @property
     def provide_data(self):
@@ -95,7 +97,7 @@ class _PyImageRecordReader:
     """cv2-based fallback matching the native loader's semantics."""
 
     def __init__(self, path, data_shape, rand_crop, rand_mirror, mean, std,
-                 resize, part_index, num_parts, seed):
+                 resize, part_index, num_parts, seed, shuffle_buffer=0):
         from . import recordio
 
         self._rec = recordio.MXRecordIO(path, "r")
@@ -109,12 +111,15 @@ class _PyImageRecordReader:
         self.num_parts = num_parts
         self._idx = 0
         self._rng = np.random.RandomState(seed)
+        self._shuffle_buffer = shuffle_buffer
+        self._pool = []
 
     def reset(self):
         self._rec.reset()
         self._idx = 0
+        self._pool = []
 
-    def _next_my_record(self):
+    def _next_sequential(self):
         while True:
             buf = self._rec.read()
             if buf is None:
@@ -123,6 +128,22 @@ class _PyImageRecordReader:
             self._idx += 1
             if mine:
                 return buf
+
+    def _next_my_record(self):
+        """Next record, through the same streaming shuffle window as the
+        native loader (bounded pool refilled sequentially, drawn uniformly)."""
+        if self._shuffle_buffer <= 0:
+            return self._next_sequential()
+        while len(self._pool) < self._shuffle_buffer:
+            buf = self._next_sequential()
+            if buf is None:
+                break
+            self._pool.append(buf)
+        if not self._pool:
+            return None
+        i = self._rng.randint(len(self._pool))
+        self._pool[i], self._pool[-1] = self._pool[-1], self._pool[i]
+        return self._pool.pop()
 
     def next_batch(self, batch_size):
         import cv2
@@ -149,6 +170,12 @@ class _PyImageRecordReader:
                                        int(img.shape[0] * scale + 0.5)))
             elif img.shape[0] != h or img.shape[1] != w:
                 img = cv2.resize(img, (w, h))
+            # edge-pad if the (resized) image is smaller than the crop —
+            # matches the native loader's edge-clamped reads
+            if img.shape[0] < h or img.shape[1] < w:
+                img = np.pad(img, ((0, max(0, h - img.shape[0])),
+                                   (0, max(0, w - img.shape[1])), (0, 0)),
+                             mode="edge")
             y0 = (img.shape[0] - h) // 2
             x0 = (img.shape[1] - w) // 2
             if self.rand_crop and img.shape[0] > h:
@@ -209,7 +236,8 @@ class CSVIter(DataIter):
         d = self._data[self._cursor:end]
         l = self._label[self._cursor:end]
         pad = 0
-        if len(d) < self.batch_size:
+        if len(d) < self.batch_size and self._round_batch:
+            # wrap around to the start, reporting the pad count
             pad = self.batch_size - len(d)
             d = np.concatenate([d, self._data[:pad]])
             l = np.concatenate([l, self._label[:pad]])
@@ -267,9 +295,13 @@ class MNISTIter(DataIter):
             self._rng.shuffle(self._order)
 
     def next(self):
-        if self._cursor + self.batch_size > len(self._images):
+        if self._cursor >= len(self._images):
             raise StopIteration
         idx = self._order[self._cursor:self._cursor + self.batch_size]
+        pad = 0
+        if len(idx) < self.batch_size:  # pad the tail batch by wrapping
+            pad = self.batch_size - len(idx)
+            idx = np.concatenate([idx, self._order[:pad]])
         self._cursor += self.batch_size
         return DataBatch([nd.array(self._images[idx])],
-                         [nd.array(self._labels[idx])], pad=0)
+                         [nd.array(self._labels[idx])], pad=pad)

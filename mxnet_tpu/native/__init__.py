@@ -57,7 +57,7 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
         ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
-        ctypes.c_int]
+        ctypes.c_int, ctypes.c_int]
     lib.mxio_imgloader_next.restype = ctypes.c_int
     lib.mxio_imgloader_next.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
@@ -114,7 +114,7 @@ class NativeImageLoader:
     def __init__(self, path, batch_size, data_shape, nthreads=4,
                  rand_crop=False, rand_mirror=False, mean_rgb=None,
                  std_rgb=None, part_index=0, num_parts=1, seed=0,
-                 resize_shorter=0, queue_depth=2):
+                 resize_shorter=0, queue_depth=2, shuffle_buffer=0):
         lib = load()
         if lib is None:
             raise RuntimeError("native io library unavailable")
@@ -129,7 +129,8 @@ class NativeImageLoader:
         self._h = lib.mxio_imgloader_create(
             path.encode(), batch_size, h, w, c, nthreads,
             int(rand_crop), int(rand_mirror), mean, std,
-            part_index, num_parts, seed, resize_shorter, queue_depth)
+            part_index, num_parts, seed, resize_shorter, queue_depth,
+            shuffle_buffer)
         if not self._h:
             raise IOError("cannot open %s" % path)
 

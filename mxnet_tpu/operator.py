@@ -1,0 +1,281 @@
+"""Custom operators written in Python.
+
+TPU-native redesign of python/mxnet/operator.py (CustomOp/CustomOpProp +
+``register``, operator.py:396-576) and the native callback bridge
+src/operator/custom/custom.cc (SURVEY §2.1 #20).
+
+The reference routes custom-op calls from the engine's async path through C
+function pointers back into Python, copying TBlobs into NDArrays
+(custom.cc:39-60ff). Here the equivalent escape hatch out of the compiled
+XLA graph is ``jax.pure_callback``: the op's forward/backward run as host
+callbacks on numpy-backed NDArrays, while the surrounding graph stays
+jit-compiled. Gradients are wired with ``jax.custom_vjp`` so a Custom op
+composes with autodiff exactly like a built-in (the reference achieves this
+by registering a synthetic backward node, custom.cc + legacy_op_util.cc).
+
+User API (identical shape to the reference):
+
+    @mx.operator.register("softmax")
+    class SoftmaxProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+        def list_arguments(self): return ['data', 'label']
+        def list_outputs(self):   return ['output']
+        def infer_shape(self, in_shape): ...
+        def create_operator(self, ctx, shapes, dtypes): return Softmax()
+
+    out = mx.nd.Custom(data, label, op_type='softmax')
+    s   = mx.sym.Custom(data=d, label=l, op_type='softmax')
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+
+# op_type -> CustomOpProp subclass (reference CustomOpProp::registry_,
+# custom.cc:13)
+_CUSTOM_OP_REGISTRY: Dict[str, type] = {}
+
+
+class CustomOp:
+    """Base class for custom operator implementations (reference
+    operator.py:396 ``CustomOp``). Subclass and override forward/backward."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    @staticmethod
+    def assign(dst, req, src):
+        """Write ``src`` into ``dst`` honoring OpReqType semantics
+        (operator.h:24-37: null/write/inplace/add)."""
+        if req in ("null", 0):
+            return
+        if req in ("write", "inplace", 1, 2):
+            dst[:] = src
+        elif req in ("add", 3):
+            dst[:] += src
+        else:
+            raise MXNetError("unknown req %r" % (req,))
+
+
+class CustomOpProp:
+    """Metadata class (reference operator.py ``CustomOpProp``; the analogue
+    of OperatorProperty, operator.h:166-480)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        """Default: all outputs take the first input's shape."""
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name: str):
+    """Register a CustomOpProp subclass under ``op_type=reg_name``
+    (reference operator.py:576 ``register`` via MXCustomOpRegister)."""
+
+    def dec(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("custom op %r must subclass CustomOpProp" % reg_name)
+        _CUSTOM_OP_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return dec
+
+
+def get_prop_cls(op_type: str) -> type:
+    try:
+        return _CUSTOM_OP_REGISTRY[op_type]
+    except KeyError:
+        raise MXNetError(
+            "custom op type %r is not registered (known: %s)"
+            % (op_type, sorted(_CUSTOM_OP_REGISTRY))
+        ) from None
+
+
+def make_prop(attrs: Dict[str, Any]) -> CustomOpProp:
+    """Instantiate the prop from Custom-op attrs. Non-``op_type`` attrs are
+    forwarded to the prop constructor as strings, matching the reference's
+    kwarg marshalling through the C bridge (custom.cc keyword char**)."""
+    kwargs = {k: str(v) for k, v in attrs.items() if k != "op_type"}
+    return get_prop_cls(str(attrs["op_type"]))(**kwargs)
+
+
+class _HostTensor:
+    """Mutable host-side tensor handed to CustomOp.forward/backward.
+
+    Behaves like the NDArray surface custom ops actually use: numpy in,
+    numpy out, in-place slice assignment (the reference copies engine TBlobs
+    into temporary NDArrays the same way, custom.cc:39-60)."""
+
+    __slots__ = ("_np",)
+
+    def __init__(self, arr: np.ndarray):
+        self._np = arr
+
+    def asnumpy(self) -> np.ndarray:
+        return self._np
+
+    @property
+    def shape(self):
+        return self._np.shape
+
+    @property
+    def dtype(self):
+        return self._np.dtype
+
+    def __getitem__(self, idx):
+        return self._np[idx]
+
+    def __setitem__(self, idx, val):
+        self._np[idx] = np.asarray(
+            val.asnumpy() if hasattr(val, "asnumpy") else val, self._np.dtype
+        )
+
+    def __array__(self, dtype=None):
+        return self._np if dtype is None else self._np.astype(dtype)
+
+
+def _result_specs(shapes, dtypes):
+    return tuple(jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
+                 for s, d in zip(shapes, dtypes))
+
+
+def apply_custom(attrs: Dict[str, Any], inputs, aux, is_train: bool):
+    """Execute a Custom op inside a traced/jitted graph.
+
+    Returns (outputs tuple, aux updates tuple). Forward and backward each
+    lower to one ``pure_callback`` into the user's Python code; ``custom_vjp``
+    splices the backward callback into the autodiff graph.
+    """
+    prop = make_prop(attrs)
+    n_out = len(prop.list_outputs())
+    n_aux = len(prop.list_auxiliary_states())
+    if len(aux) != n_aux:
+        raise MXNetError(
+            "Custom(%s): expected %d aux states, got %d"
+            % (attrs.get("op_type"), n_aux, len(aux))
+        )
+
+    in_shapes = [tuple(x.shape) for x in inputs]
+    _, out_shapes, aux_shapes = prop.infer_shape([list(s) for s in in_shapes])
+    in_types = [x.dtype for x in inputs]
+    _, out_types, _ = prop.infer_type(in_types)
+    out_specs = _result_specs(out_shapes, out_types)
+    aux_specs = _result_specs([a.shape for a in aux], [a.dtype for a in aux])
+
+    op_holder: List[Optional[CustomOp]] = [None]
+
+    def get_op():
+        if op_holder[0] is None:
+            op_holder[0] = prop.create_operator(
+                None, [list(s) for s in in_shapes], in_types
+            )
+        return op_holder[0]
+
+    n_in = len(inputs)
+
+    def fwd_cb(*arrays):
+        ins = [_HostTensor(np.asarray(a).copy()) for a in arrays[:n_in]]
+        auxs = [_HostTensor(np.asarray(a).copy()) for a in arrays[n_in:]]
+        outs = [_HostTensor(np.zeros(s.shape, s.dtype)) for s in out_specs]
+        get_op().forward(is_train, ["write"] * n_out, ins, outs, auxs)
+        return tuple(o.asnumpy().astype(s.dtype) for o, s in zip(outs, out_specs)) + tuple(
+            a.asnumpy().astype(sp.dtype) for a, sp in zip(auxs, aux_specs)
+        )
+
+    def bwd_cb(*arrays):
+        # layout: inputs, outputs, aux, out_grads
+        ofs = 0
+        ins = [_HostTensor(np.asarray(a).copy()) for a in arrays[ofs:ofs + n_in]]
+        ofs += n_in
+        outs = [_HostTensor(np.asarray(a).copy()) for a in arrays[ofs:ofs + n_out]]
+        ofs += n_out
+        auxs = [_HostTensor(np.asarray(a).copy()) for a in arrays[ofs:ofs + n_aux]]
+        ofs += n_aux
+        ograds = [_HostTensor(np.asarray(a).copy()) for a in arrays[ofs:]]
+        igrads = [_HostTensor(np.zeros(s, np.dtype(d)))
+                  for s, d in zip(in_shapes, in_types)]
+        get_op().backward(["write"] * n_in, ograds, ins, outs, igrads, auxs)
+        return tuple(g.asnumpy().astype(d) for g, d in zip(igrads, in_types))
+
+    in_specs = _result_specs(in_shapes, in_types)
+
+    @jax.custom_vjp
+    def run(*ins):
+        res = jax.pure_callback(fwd_cb, out_specs + aux_specs, *ins, *aux)
+        return tuple(res)
+
+    def run_fwd(*ins):
+        res = run(*ins)
+        return res, (ins, res[:n_out])
+
+    def run_bwd(residuals, cotangents):
+        ins, outs = residuals
+        ograds = cotangents[:n_out]
+        igrads = jax.pure_callback(
+            bwd_cb, in_specs, *ins, *outs, *aux, *ograds
+        )
+        return tuple(igrads)
+
+    run.defvjp(run_fwd, run_bwd)
+    res = run(*inputs)
+    return tuple(res[:n_out]), tuple(res[n_out:])
+
+
+# --- legacy interfaces (reference NDArrayOp/NumpyOp, operator.py:28-390) ----
+class PythonOp(CustomOp):
+    """Legacy-style numpy op base (reference NumpyOp). Implement
+    ``forward(in_data, out_data)`` / ``backward(out_grad, in_data, out_data,
+    in_grad)`` over numpy arrays; adapted onto the CustomOp interface."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):  # noqa: D102
+        self.forward_np([x.asnumpy() for x in in_data],
+                        [x.asnumpy() for x in out_data])
+        # forward_np mutates the out numpy arrays in place via _HostTensor
+        for o in out_data:
+            self.assign(o, req[0] if req else "write", o.asnumpy())
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.backward_np([x.asnumpy() for x in out_grad],
+                         [x.asnumpy() for x in in_data],
+                         [x.asnumpy() for x in out_data],
+                         [x.asnumpy() for x in in_grad])
+
+    def forward_np(self, in_data, out_data):
+        raise NotImplementedError
+
+    def backward_np(self, out_grad, in_data, out_data, in_grad):
+        raise NotImplementedError

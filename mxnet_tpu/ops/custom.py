@@ -1,0 +1,40 @@
+"""The ``Custom`` operator — graph-side entry for Python custom ops.
+
+Registers ``Custom`` in the op registry, dispatching to user classes
+registered with ``mxnet_tpu.operator.register`` (reference
+src/operator/custom/custom.cc `_Custom` registration + the `_Native` /
+`_NDArray` legacy callback ops, SURVEY §2.1 #20). arg/aux/output names are
+resolved dynamically by instantiating the user's CustomOpProp — the same
+flow as CustomOpProp::ListArguments through the C callback table.
+"""
+from __future__ import annotations
+
+from .registry import OpDef, register_op
+
+
+def _prop(attrs):
+    from .. import operator as _operator
+
+    return _operator.make_prop(attrs)
+
+
+def _impl(attrs, inputs, aux, ctx):
+    from .. import operator as _operator
+
+    return _operator.apply_custom(attrs, inputs, aux, ctx.is_train)
+
+
+register_op(
+    OpDef(
+        name="Custom",
+        impl=_impl,
+        arg_names=lambda attrs: tuple(_prop(attrs).list_arguments()),
+        aux_names=lambda attrs: tuple(_prop(attrs).list_auxiliary_states()),
+        num_outputs=lambda attrs: len(_prop(attrs).list_outputs()),
+        output_names=lambda attrs: list(_prop(attrs).list_outputs()),
+        param_spec=None,  # op_type + free-form kwargs for the prop ctor
+        uses_train=True,
+        doc=_impl.__doc__ or "Apply a registered Python custom operator.",
+        py_name="Custom",
+    )
+)

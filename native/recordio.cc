@@ -170,6 +170,12 @@ struct ImgLoader {
   Reader reader;
   int nthreads;
   uint64_t seed;
+  // streaming shuffle window (reference: ImageRecordIOParser shuffle_chunk —
+  // records are drawn uniformly from a bounded pool that refills from the
+  // sequential reader; 0 disables)
+  int shuffle_buffer = 0;
+  std::vector<std::vector<uint8_t>> shuffle_pool;
+  std::mt19937_64 shuffle_rng;
 
   std::mutex mu;
   std::condition_variable cv_full, cv_free;
@@ -186,19 +192,19 @@ struct ImgLoader {
     int slot;
   };
 
-  void DecodeInto(const Work& w, Batch* b, std::mt19937* rng) {
+  bool DecodeInto(const Work& w, Batch* b, std::mt19937* rng) {
     const uint8_t* p = w.rec.data();
     size_t len = w.rec.size();
-    if (len < 24) return;
+    if (len < 24) return false;
     uint32_t flag;
     float label;
     memcpy(&flag, p, 4);
     memcpy(&label, p + 4, 4);
     size_t off = 24 + (flag > 1 ? (size_t)flag * 4 : 0);
-    if (off >= len) return;
+    if (off >= len) return false;
     int w0, h0;
     std::vector<uint8_t> rgb, resized;
-    if (!DecodeJpeg(p + off, len - off, &rgb, &w0, &h0)) return;
+    if (!DecodeJpeg(p + off, len - off, &rgb, &w0, &h0)) return false;
 
     const LoaderCfg& c = cfg;
     int cw = c.W, ch = c.H;
@@ -238,6 +244,25 @@ struct ImgLoader {
       }
     }
     b->labels[w.slot] = label;
+    return true;
+  }
+
+  // Pull the next record, optionally through the shuffle window.
+  bool NextRecord(std::vector<uint8_t>* out) {
+    if (shuffle_buffer <= 0) {
+      if (!reader.Next()) return false;
+      *out = reader.buf;
+      return true;
+    }
+    while ((int)shuffle_pool.size() < shuffle_buffer && reader.Next()) {
+      shuffle_pool.push_back(reader.buf);
+    }
+    if (shuffle_pool.empty()) return false;
+    size_t i = shuffle_rng() % shuffle_pool.size();
+    std::swap(shuffle_pool[i], shuffle_pool.back());
+    *out = std::move(shuffle_pool.back());
+    shuffle_pool.pop_back();
+    return true;
   }
 
   void ProducerLoop() {
@@ -255,8 +280,7 @@ struct ImgLoader {
       // read batch-many records (single-threaded IO, parallel decode)
       int n = 0;
       for (; n < cfg.batch; ++n) {
-        if (!reader.Next()) break;
-        works[n].rec = reader.buf;
+        if (!NextRecord(&works[n].rec)) break;
         works[n].slot = n;
       }
       if (n == 0) {
@@ -269,13 +293,15 @@ struct ImgLoader {
         cv_full.notify_all();
         return;
       }
-      b->n = n;
-      // parallel decode
+      // parallel decode; track per-slot success so corrupt records are
+      // dropped, not silently fed as stale recycled-buffer pixels
       std::atomic<int> next{0};
+      std::vector<char> ok(n, 0);
       auto decode_fn = [&](uint64_t tid) {
         std::mt19937 rng((uint32_t)(seed + tid * 9973 + reader.rec_idx));
         int i;
-        while ((i = next.fetch_add(1)) < n) DecodeInto(works[i], b, &rng);
+        while ((i = next.fetch_add(1)) < n)
+          ok[i] = DecodeInto(works[i], b, &rng) ? 1 : 0;
       };
       if (nthreads <= 1) {
         decode_fn(0);
@@ -284,6 +310,25 @@ struct ImgLoader {
         for (int t = 0; t < nthreads; ++t) ts.emplace_back(decode_fn, t);
         for (auto& t : ts) t.join();
       }
+      // compact failed slots out of the batch
+      size_t img = (size_t)cfg.C * cfg.H * cfg.W;
+      int m = 0;
+      for (int i = 0; i < n; ++i) {
+        if (!ok[i]) continue;
+        if (m != i) {
+          memcpy(b->data.data() + (size_t)m * img,
+                 b->data.data() + (size_t)i * img, img * sizeof(float));
+          b->labels[m] = b->labels[i];
+        }
+        ++m;
+      }
+      if (m == 0) {  // every record in this batch was corrupt — skip it
+        std::lock_guard<std::mutex> lk(mu);
+        free_pool.push(b);
+        cv_free.notify_one();
+        continue;
+      }
+      b->n = m;
       {
         std::lock_guard<std::mutex> lk(mu);
         ready.push(b);
@@ -361,7 +406,8 @@ void* mxio_imgloader_create(const char* path, int batch, int H, int W, int C,
                             int nthreads, int rand_crop, int rand_mirror,
                             const float* mean_rgb, const float* std_rgb,
                             int part, int nparts, uint64_t seed,
-                            int resize_shorter, int queue_depth) {
+                            int resize_shorter, int queue_depth,
+                            int shuffle_buffer) {
   FILE* fp = fopen(path, "rb");
   if (!fp) return nullptr;
   ImgLoader* L = new ImgLoader();
@@ -376,6 +422,8 @@ void* mxio_imgloader_create(const char* path, int batch, int H, int W, int C,
   }
   L->nthreads = nthreads;
   L->seed = seed;
+  L->shuffle_buffer = shuffle_buffer;
+  L->shuffle_rng.seed(seed ? seed : 0x9e3779b97f4a7c15ull);
   if (queue_depth < 2) queue_depth = 2;
   L->storage.resize(queue_depth);
   for (auto& b : L->storage) {
@@ -396,7 +444,14 @@ int mxio_imgloader_next(void* h, float* data, float* labels) {
     b = L->ready.front();
     L->ready.pop();
   }
-  if (b == nullptr) return 0;  // EOF
+  if (b == nullptr) {  // EOF: re-push the sentinel so EOF is sticky and
+    {                  // later calls return 0 instead of deadlocking
+      std::lock_guard<std::mutex> lk(L->mu);
+      L->ready.push(nullptr);
+    }
+    L->cv_full.notify_all();
+    return 0;
+  }
   memcpy(data, b->data.data(), b->data.size() * 4);
   memcpy(labels, b->labels.data(), b->labels.size() * 4);
   int n = b->n;
@@ -419,6 +474,7 @@ void mxio_imgloader_reset(void* h) {
       if (b) L->free_pool.push(b);
     }
   }
+  L->shuffle_pool.clear();
   L->reader.Reset();
   L->Start();
 }

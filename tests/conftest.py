@@ -11,3 +11,11 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The TPU-pool sitecustomize force-registers the axon PJRT plugin and resets
+# jax_platforms to "axon,cpu", overriding the env var — pin it back so the
+# suite never touches (or blocks on) the real-chip tunnel. Tests are strictly
+# the virtual 8-device CPU mesh; real-chip runs happen via bench.py.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

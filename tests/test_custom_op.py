@@ -1,0 +1,76 @@
+"""Custom (Python) operator tests — analogue of the reference's custom-op
+coverage in tests/python/unittest/test_operator.py (CustomOp section)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+@mx.operator.register("tsquare")
+class SquareProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Square()
+
+
+class Square(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0].asnumpy() ** 2)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0],
+                    2.0 * in_data[0].asnumpy() * out_grad[0].asnumpy())
+
+
+def test_custom_imperative_forward():
+    x = np.random.randn(3, 4).astype(np.float32)
+    out = nd.Custom(nd.array(x), op_type="tsquare").asnumpy()
+    np.testing.assert_allclose(out, x ** 2, rtol=1e-5)
+
+
+def test_custom_imperative_autograd():
+    from mxnet_tpu import autograd
+    x = nd.array(np.random.randn(2, 3).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="tsquare")
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(), rtol=1e-5)
+
+
+def test_custom_symbolic_forward_backward():
+    data = mx.sym.Variable("data")
+    y = mx.sym.Custom(data=data, op_type="tsquare", name="sq")
+    xval = np.random.randn(4, 5).astype(np.float32)
+    exe = y.simple_bind(mx.cpu(), data=(4, 5), grad_req="write")
+    exe.arg_dict["data"][:] = xval
+    out = exe.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out, xval ** 2, rtol=1e-5)
+    exe.backward(out_grads=nd.ones((4, 5)))
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(), 2 * xval,
+                               rtol=1e-5)
+
+
+def test_custom_unregistered_raises():
+    with pytest.raises(mx.MXNetError):
+        nd.Custom(nd.ones((2, 2)), op_type="definitely_not_registered")
+
+
+def test_custom_shape_inference_through_symbol():
+    data = mx.sym.Variable("data")
+    y = mx.sym.Custom(data=data, op_type="tsquare")
+    arg_shapes, out_shapes, _ = y.infer_shape(data=(7, 2))
+    assert tuple(out_shapes[0]) == (7, 2)
