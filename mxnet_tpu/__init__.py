@@ -35,6 +35,10 @@ from . import test_utils
 from . import model
 from .model import FeedForward
 from . import operator
+from . import models
 from . import recordio
+from . import rtc
+from . import predict
+from . import engine
 from . import rnn
 from . import profiler

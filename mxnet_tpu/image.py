@@ -278,3 +278,230 @@ class ImageIter(DataIter):
                 raise
         return DataBatch([nd.array(batch_data)], [nd.array(batch_label)],
                          pad=self.batch_size - i)
+
+
+# --- detection augmenters (reference src/io/image_det_aug_default.cc,
+# python ImageDetIter) -------------------------------------------------------
+class DetAugmenter:
+    """Augmenter over (image, boxes) pairs; boxes are (N, 5) arrays of
+    [cls, xmin, ymin, xmax, ymax] normalized to [0, 1]."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap a geometry-free classification augmenter (color jitter, cast)."""
+
+    def __init__(self, augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            src = nd.array(np.ascontiguousarray(src.asnumpy()[:, ::-1]))
+            label = label.copy()
+            xmin = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - xmin
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Box-aware random crop with IoU/coverage constraint (the SSD
+    "min_object_covered" sampler, image_det_aug_default.cc RandomCrop)."""
+
+    def __init__(self, min_object_covered=0.3, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.3, 1.0), max_attempts=25):
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+
+    def __call__(self, src, label):
+        h, w = src.shape[:2]
+        for _ in range(self.max_attempts):
+            area = pyrandom.uniform(*self.area_range)
+            ar = pyrandom.uniform(*self.aspect_ratio_range)
+            cw = min(1.0, np.sqrt(area * ar))
+            ch = min(1.0, np.sqrt(area / ar))
+            cx0 = pyrandom.uniform(0, 1 - cw)
+            cy0 = pyrandom.uniform(0, 1 - ch)
+            crop = np.array([cx0, cy0, cx0 + cw, cy0 + ch], np.float32)
+            kept = self._crop_boxes(label, crop)
+            if kept is None:
+                continue
+            x0, y0 = int(cx0 * w), int(cy0 * h)
+            cw_px, ch_px = max(1, int(cw * w)), max(1, int(ch * h))
+            img = src.asnumpy()[y0:y0 + ch_px, x0:x0 + cw_px]
+            return nd.array(img), kept
+        return src, label
+
+    def _crop_boxes(self, label, crop):
+        """Keep boxes whose center lies in the crop; require coverage."""
+        if len(label) == 0:
+            return label
+        cx = (label[:, 1] + label[:, 3]) / 2
+        cy = (label[:, 2] + label[:, 4]) / 2
+        inside = ((cx >= crop[0]) & (cx <= crop[2])
+                  & (cy >= crop[1]) & (cy <= crop[3]))
+        if not inside.any():
+            return None
+        kept = label[inside].copy()
+        # coverage check: clipped area / original area
+        ow = kept[:, 3] - kept[:, 1]
+        oh = kept[:, 4] - kept[:, 2]
+        nx0 = np.maximum(kept[:, 1], crop[0])
+        ny0 = np.maximum(kept[:, 2], crop[1])
+        nx1 = np.minimum(kept[:, 3], crop[2])
+        ny1 = np.minimum(kept[:, 4], crop[3])
+        cover = (np.clip(nx1 - nx0, 0, None) * np.clip(ny1 - ny0, 0, None)
+                 / np.clip(ow * oh, 1e-12, None))
+        if cover.min() < self.min_object_covered:
+            return None
+        cw = crop[2] - crop[0]
+        ch = crop[3] - crop[1]
+        kept[:, 1] = np.clip((nx0 - crop[0]) / cw, 0, 1)
+        kept[:, 2] = np.clip((ny0 - crop[1]) / ch, 0, 1)
+        kept[:, 3] = np.clip((nx1 - crop[0]) / cw, 0, 1)
+        kept[:, 4] = np.clip((ny1 - crop[1]) / ch, 0, 1)
+        return kept
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Zoom-out: place the image on a larger filled canvas and rescale
+    boxes (image_det_aug_default.cc RandomPad)."""
+
+    def __init__(self, max_expand_ratio=2.0, fill=(127, 127, 127), p=0.5):
+        self.max_expand_ratio = max_expand_ratio
+        self.fill = fill
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() >= self.p or self.max_expand_ratio <= 1.0:
+            return src, label
+        h, w = src.shape[:2]
+        ratio = pyrandom.uniform(1.0, self.max_expand_ratio)
+        nh, nw = int(h * ratio), int(w * ratio)
+        y0 = pyrandom.randint(0, nh - h)
+        x0 = pyrandom.randint(0, nw - w)
+        canvas = np.empty((nh, nw, src.shape[2]), src.asnumpy().dtype)
+        canvas[:] = np.asarray(self.fill, canvas.dtype)
+        canvas[y0:y0 + h, x0:x0 + w] = src.asnumpy()
+        label = label.copy()
+        label[:, 1] = (label[:, 1] * w + x0) / nw
+        label[:, 3] = (label[:, 3] * w + x0) / nw
+        label[:, 2] = (label[:, 2] * h + y0) / nh
+        label[:, 4] = (label[:, 4] * h + y0) / nh
+        return nd.array(canvas), label
+
+
+class DetResizeAug(DetAugmenter):
+    """Force resize to (w, h); normalized boxes are unchanged."""
+
+    def __init__(self, size, interp=1):
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src, label):
+        return imresize(src, self.size[0], self.size[1], self.interp), label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0.0, rand_pad=0.0,
+                       rand_mirror=False, mean=None, std=None, brightness=0,
+                       min_object_covered=0.3,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.3, 1.0), max_expand_ratio=2.0,
+                       pad_val=(127, 127, 127), inter_method=1):
+    """Build the standard detection augmenter list (reference
+    image_det_aug_default.cc CreateDetAugmenter)."""
+    auglist: List[DetAugmenter] = []
+    if rand_pad > 0:
+        auglist.append(DetRandomPadAug(max_expand_ratio, pad_val, rand_pad))
+    if rand_crop > 0:
+        auglist.append(DetRandomCropAug(min_object_covered,
+                                        aspect_ratio_range, area_range))
+    auglist.append(DetResizeAug((data_shape[2], data_shape[1]), inter_method))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    auglist.append(DetBorrowAug(CastAug()))
+    if brightness:
+        auglist.append(DetBorrowAug(BrightnessJitterAug(brightness)))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator (reference ImageDetRecordIter,
+    src/io/iter_image_det_recordio.cc + python image.ImageDetIter): yields
+    (data (B,C,H,W), label (B, max_objs, 5)) with -1 padding rows.
+
+    Record label layout follows the reference det format: either a flat
+    multiple of ``object_width`` (5), or ``[header_width, object_width,
+    ...header, objects...]``."""
+
+    def __init__(self, batch_size, data_shape, max_objs=16, aug_list=None,
+                 **kwargs):
+        self.max_objs = max_objs
+        if aug_list is None:
+            det_kwargs = {k: v for k, v in kwargs.items()
+                          if k in ("resize", "rand_crop", "rand_pad",
+                                   "rand_mirror", "mean", "std", "brightness",
+                                   "min_object_covered", "aspect_ratio_range",
+                                   "area_range", "max_expand_ratio",
+                                   "pad_val", "inter_method")}
+            aug_list = CreateDetAugmenter(data_shape, **det_kwargs)
+            kwargs = {k: v for k, v in kwargs.items() if k not in det_kwargs}
+        super().__init__(batch_size, data_shape, aug_list=[], **kwargs)
+        self.det_auglist = aug_list
+
+    @property
+    def provide_label(self):
+        return [DataDesc("label", (self.batch_size, self.max_objs, 5))]
+
+    @staticmethod
+    def _parse_label(raw):
+        raw = np.asarray(raw, np.float32).reshape(-1)
+        if len(raw) == 0:
+            return np.zeros((0, 5), np.float32)
+        if len(raw) >= 2 and len(raw) % 5 != 0:
+            hw, ow = int(raw[0]), int(raw[1])
+            body = raw[hw:]
+            return body.reshape(-1, ow)[:, :5].astype(np.float32)
+        return raw.reshape(-1, 5).astype(np.float32)
+
+    def next(self):
+        c, h, w = self.data_shape
+        batch_data = np.zeros((self.batch_size, c, h, w), np.float32)
+        batch_label = -np.ones((self.batch_size, self.max_objs, 5), np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                raw_label, buf = self.next_sample()
+                img = imdecode(buf)
+                boxes = self._parse_label(raw_label)
+                for aug in self.det_auglist:
+                    img, boxes = aug(img, boxes)
+                arr = img.asnumpy()
+                batch_data[i] = arr.transpose(2, 0, 1)
+                n = min(len(boxes), self.max_objs)
+                if n:
+                    batch_label[i, :n] = boxes[:n, :5]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        return DataBatch([nd.array(batch_data)], [nd.array(batch_label)],
+                         pad=self.batch_size - i)

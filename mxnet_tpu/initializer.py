@@ -73,9 +73,9 @@ class Initializer:
         shape = arr.shape
         f = np.ceil(shape[3] / 2.0)
         c = (2 * f - 1 - f % 2) / (2.0 * f)
-        for i in range(np.prod(shape)):
+        for i in range(int(np.prod(shape))):
             x = i % shape[3]
-            y = (i / shape[3]) % shape[2]
+            y = (i // shape[3]) % shape[2]
             weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
         arr[:] = weight.reshape(shape)
 
@@ -220,11 +220,14 @@ class LSTMBias(Initializer):
         super().__init__(forget_bias=forget_bias)
         self.forget_bias = forget_bias
 
-    def _init_weight(self, _, arr):
+    def _init_bias(self, _, arr):
+        # bias-suffixed names dispatch here, not to _init_weight
         v = np.zeros(arr.shape, np.float32)
         num_hidden = int(arr.shape[0] / 4)
         v[num_hidden : 2 * num_hidden] = self.forget_bias
         arr[:] = v
+
+    _init_weight = _init_bias  # tolerate non-_bias-suffixed param names
 
 
 @register

@@ -16,6 +16,7 @@ from . import inception_v3
 from . import resnet
 from . import lstm_lm
 from . import transformer
+from . import ssd
 
 _BUILDERS = {
     "mlp": mlp.get_symbol,
@@ -34,6 +35,7 @@ _BUILDERS = {
     "resnet-152": lambda **kw: resnet.get_symbol(num_layers=152, **kw),
     "lstm-lm": lstm_lm.get_symbol,
     "transformer-lm": transformer.get_symbol,
+    "ssd-vgg16": ssd.get_symbol,
 }
 
 

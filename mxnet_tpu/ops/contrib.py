@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..base import MXNetError
 from .registry import defop
 
 _NEG = -1e9
@@ -408,3 +409,70 @@ def _proposal(attrs, cls_prob, bbox_pred, im_info):
     if attrs["output_score"]:
         return rois, scores[top_idx][:, None]
     return rois
+
+
+# ---------------------------------------------------------------------------
+# Correlation (reference src/operator/correlation.cc / correlation-inl.h —
+# the FlowNet cost-volume layer). TPU-native: the displacement window is a
+# static (D*D)-way batch of channel-mean products, each an XLA-fused
+# elementwise-multiply + reduce over a shifted view — no scalar loops, so
+# the whole cost volume compiles to one fused HLO.
+# ---------------------------------------------------------------------------
+@defop(
+    "Correlation",
+    arg_names=("data1", "data2"),
+    param_spec={"kernel_size": 1, "max_displacement": 1, "stride1": 1,
+                "stride2": 1, "pad_size": 0, "is_multiply": True},
+)
+def _correlation(attrs, data1, data2):
+    """Cost volume between two (B, C, H, W) feature maps.
+
+    out[b, d, y, x] = mean over the kernel window and channels of
+    data1[...y*s1, x*s1] (*|-) data2 shifted by displacement d, where d
+    ranges over a (2*max_displacement/stride2+1)^2 grid. is_multiply=False
+    uses absolute difference (reference CorrelationParam::is_multiply).
+    """
+    k = int(attrs["kernel_size"])
+    md = int(attrs["max_displacement"])
+    s1 = int(attrs["stride1"])
+    s2 = int(attrs["stride2"])
+    pad = int(attrs["pad_size"])
+    b, c, h, w = data1.shape
+    rad = k // 2
+    d_per_side = md // s2
+    disp = [i * s2 for i in range(-d_per_side, d_per_side + 1)]
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    ph, pw = h + 2 * pad, w + 2 * pad
+    # valid center positions: [border, size - border) stepped by stride1
+    border = max(md, rad)
+    ys = list(range(border, ph - border, s1))
+    xs = list(range(border, pw - border, s1))
+    out_h, out_w = len(ys), len(xs)
+    if out_h == 0 or out_w == 0:
+        raise MXNetError("Correlation: displacement/pad config leaves no "
+                         "valid output positions")
+    y0, x0 = ys[0], xs[0]
+
+    def window(x, dy, dx):
+        # (B, C, out_h*k, out_w*k) gather of the kernel windows at centers
+        sl = jax.lax.dynamic_slice(
+            x, (0, 0, y0 + dy - rad, x0 + dx - rad),
+            (b, c, (out_h - 1) * s1 + k, (out_w - 1) * s1 + k))
+        # extract k×k patches stepped by stride1
+        patches = [sl[:, :, i:i + (out_h - 1) * s1 + 1:s1,
+                      j:j + (out_w - 1) * s1 + 1:s1]
+                   for i in range(k) for j in range(k)]
+        return jnp.stack(patches, axis=2)  # (B, C, k*k, out_h, out_w)
+
+    f1 = window(p1, 0, 0)
+    maps = []
+    for dy in disp:
+        for dx in disp:
+            f2 = window(p2, dy, dx)
+            if attrs["is_multiply"]:
+                m = jnp.mean(f1 * f2, axis=(1, 2))
+            else:
+                m = jnp.mean(jnp.abs(f1 - f2), axis=(1, 2))
+            maps.append(m)
+    return jnp.stack(maps, axis=1)  # (B, D*D, out_h, out_w)

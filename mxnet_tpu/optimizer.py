@@ -423,6 +423,14 @@ class Updater:
 
     def set_states(self, states):
         blob = pickle.loads(states)
+        counts = blob.pop("__update_counts__", None)
+        if counts is not None:
+            # restore per-index step counts so bias-corrected optimizers
+            # (Adam) continue from the right timestep after resume
+            self.optimizer._index_update_count = dict(counts)
+            if counts:
+                self.optimizer.num_update = max(
+                    self.optimizer.num_update, max(counts.values()))
         restored = {}
         for k, v in blob.items():
             if isinstance(v, tuple):
@@ -441,7 +449,9 @@ class Updater:
                 return tuple(None if x is None else x.asnumpy() for x in v)
             return v.asnumpy()
 
-        return pickle.dumps({k: conv(v) for k, v in self.states.items()})
+        blob = {k: conv(v) for k, v in self.states.items()}
+        blob["__update_counts__"] = dict(self.optimizer._index_update_count)
+        return pickle.dumps(blob)
 
 
 def get_updater(optimizer: Optimizer) -> Updater:

@@ -526,10 +526,10 @@ class ModifierCell(BaseRNNCell):
     def state_info(self):
         return self.base_cell.state_info
 
-    def begin_state(self, init_sym=symbol.zeros, **kwargs):
+    def begin_state(self, func=symbol.zeros, **kwargs):
         assert not self._modified
         self.base_cell._modified = False
-        begin = self.base_cell.begin_state(func=init_sym, **kwargs)
+        begin = self.base_cell.begin_state(func=func, **kwargs)
         self.base_cell._modified = True
         return begin
 

@@ -1,0 +1,147 @@
+"""Detection pipeline tests: SSD symbol, Correlation, det augmenters,
+ImageDetIter — reference analogues: example/ssd, src/operator/correlation.cc,
+src/io/image_det_aug_default.cc (SURVEY §7 S9)."""
+import io as _io
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as mimg
+from mxnet_tpu import ndarray as nd
+
+
+def test_correlation_matches_numpy():
+    rng = np.random.RandomState(0)
+    b, c, h, w = 2, 3, 8, 8
+    d1 = rng.randn(b, c, h, w).astype(np.float32)
+    d2 = rng.randn(b, c, h, w).astype(np.float32)
+    md, pad = 2, 2
+    out = nd.Correlation(nd.array(d1), nd.array(d2), kernel_size=1,
+                         max_displacement=md, stride1=1, stride2=1,
+                         pad_size=pad, is_multiply=True).asnumpy()
+    p1 = np.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = np.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    ph = h + 2 * pad
+    ys = list(range(md, ph - md))
+    disp = list(range(-md, md + 1))
+    ref = np.zeros((b, len(disp) ** 2, len(ys), len(ys)), np.float32)
+    for bi in range(b):
+        for di, dy in enumerate(disp):
+            for dj, dx in enumerate(disp):
+                for yi, y in enumerate(ys):
+                    for xi, x in enumerate(ys):
+                        ref[bi, di * len(disp) + dj, yi, xi] = np.mean(
+                            p1[bi, :, y, x] * p2[bi, :, y + dy, x + dx])
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_abs_difference_mode():
+    rng = np.random.RandomState(1)
+    d1 = rng.randn(1, 2, 6, 6).astype(np.float32)
+    d2 = rng.randn(1, 2, 6, 6).astype(np.float32)
+    out = nd.Correlation(nd.array(d1), nd.array(d2), max_displacement=1,
+                         pad_size=1, is_multiply=False).asnumpy()
+    assert out.shape == (1, 9, 6, 6)
+    assert (out >= 0).all()
+
+
+def test_ssd_symbol_shapes():
+    net = mx.models.get_symbol("ssd-vgg16", num_classes=3, mode="train")
+    _, out_shapes, _ = net.infer_shape(data=(2, 3, 128, 128),
+                                       label=(2, 8, 5))
+    # outputs: cls_prob (B, C+1, A), loc_loss, cls_target (B, A)
+    assert out_shapes[0][0] == 2 and out_shapes[0][1] == 4
+    n_anchors = out_shapes[0][2]
+    assert out_shapes[2] == (2, n_anchors)
+
+
+def test_ssd_forward_backward():
+    net = mx.models.get_symbol("ssd-vgg16", num_classes=3, mode="train")
+    exe = net.simple_bind(mx.cpu(), grad_req="write",
+                          data=(1, 3, 128, 128), label=(1, 4, 5))
+    init = mx.initializer.Xavier()
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "label"):
+            init(mx.initializer.InitDesc(name), arr)
+    exe.arg_dict["data"][:] = np.random.randn(1, 3, 128, 128).astype(np.float32)
+    lab = -np.ones((1, 4, 5), np.float32)
+    lab[0, 0] = [1, 0.1, 0.1, 0.4, 0.5]
+    lab[0, 1] = [2, 0.5, 0.5, 0.9, 0.9]
+    exe.arg_dict["label"][:] = lab
+    outs = exe.forward(is_train=True)
+    assert np.isfinite(outs[1].asnumpy()).all()
+    exe.backward()
+    g = exe.grad_dict["conv1_1_weight"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_ssd_detect_mode():
+    net = mx.models.get_symbol("ssd-vgg16", num_classes=3, mode="detect")
+    exe = net.simple_bind(mx.cpu(), grad_req="null", data=(1, 3, 128, 128))
+    exe.arg_dict["data"][:] = np.random.randn(1, 3, 128, 128).astype(np.float32)
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert out.shape[2] == 6  # [cls, score, xmin, ymin, xmax, ymax]
+
+
+def test_det_hflip_moves_boxes():
+    img = nd.array(np.random.randint(0, 255, (10, 20, 3)).astype(np.uint8))
+    boxes = np.array([[0, 0.1, 0.2, 0.3, 0.4]], np.float32)
+    aug = mimg.DetHorizontalFlipAug(p=1.0)
+    _, out = aug(img, boxes)
+    np.testing.assert_allclose(out[0], [0, 0.7, 0.2, 0.9, 0.4], atol=1e-6)
+
+
+def test_det_random_crop_keeps_coverage():
+    rng = np.random.RandomState(0)
+    img = nd.array(rng.randint(0, 255, (64, 64, 3)).astype(np.uint8))
+    boxes = np.array([[1, 0.4, 0.4, 0.6, 0.6]], np.float32)
+    aug = mimg.DetRandomCropAug(min_object_covered=0.5,
+                                area_range=(0.5, 1.0))
+    for _ in range(10):
+        _, out = aug(img, boxes)
+        assert len(out) >= 1
+        assert (out[:, 1:] >= 0).all() and (out[:, 1:] <= 1).all()
+
+
+def test_det_pad_rescales_boxes():
+    img = nd.array(np.zeros((10, 10, 3), np.uint8))
+    boxes = np.array([[0, 0.0, 0.0, 1.0, 1.0]], np.float32)
+    aug = mimg.DetRandomPadAug(max_expand_ratio=2.0, p=1.0)
+    out_img, out = aug(img, boxes)
+    w = out[0, 3] - out[0, 1]
+    assert w <= 1.0 and out_img.shape[0] >= 10
+
+
+def _write_det_rec(path, n=6):
+    from PIL import Image
+    from mxnet_tpu import recordio
+
+    w = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = Image.fromarray(rng.randint(0, 255, (48, 48, 3), dtype=np.uint8))
+        b = _io.BytesIO()
+        img.save(b, "JPEG")
+        # det label: [header_width=2, object_width=5, cls,x0,y0,x1,y1]
+        label = np.array([2, 5, i % 3, 0.2, 0.2, 0.8, 0.8], np.float32)
+        hdr = recordio.IRHeader(flag=len(label), label=label, id=i, id2=0)
+        w.write(recordio.pack(hdr, b.getvalue()))
+    w.close()
+
+
+def test_image_det_iter():
+    with tempfile.TemporaryDirectory() as tmp:
+        rec = os.path.join(tmp, "det.rec")
+        _write_det_rec(rec)
+        it = mimg.ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                               path_imgrec=rec, max_objs=4,
+                               rand_mirror=True)
+        batch = it.next()
+        assert batch.data[0].shape == (4, 3, 32, 32)
+        lab = batch.label[0].asnumpy()
+        assert lab.shape == (4, 4, 5)
+        assert (lab[:, 0, 0] >= 0).all()  # first object row is real
+        assert (lab[:, 1:, 0] == -1).all()  # padding rows

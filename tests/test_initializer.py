@@ -1,0 +1,75 @@
+"""Initializer tests (reference test_init.py + initializer.py registry)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import initializer as init
+from mxnet_tpu import ndarray as nd
+
+
+def _init_arr(initializer, name, shape):
+    arr = nd.zeros(shape)
+    initializer(init.InitDesc(name), arr)
+    return arr.asnumpy()
+
+
+def test_zero_one_constant():
+    assert (_init_arr(init.Zero(), "w_weight", (3, 3)) == 0).all()
+    assert (_init_arr(init.One(), "w_weight", (3, 3)) == 1).all()
+    assert (_init_arr(init.Constant(2.5), "w_weight", (3, 3)) == 2.5).all()
+
+
+def test_uniform_normal_ranges():
+    u = _init_arr(init.Uniform(0.1), "w_weight", (200, 50))
+    assert np.abs(u).max() <= 0.1 + 1e-6
+    n = _init_arr(init.Normal(0.5), "w_weight", (200, 50))
+    assert abs(n.std() - 0.5) < 0.05
+
+
+def test_xavier_fan_scaling():
+    x = _init_arr(init.Xavier(rnd_type="uniform", factor_type="avg",
+                              magnitude=3), "w_weight", (100, 400))
+    bound = np.sqrt(3.0 / ((100 + 400) / 2))
+    assert np.abs(x).max() <= bound + 1e-6
+    assert np.abs(x).max() > bound * 0.8
+
+
+def test_orthogonal_is_orthogonal():
+    o = _init_arr(init.Orthogonal(scale=1.414), "w_weight", (32, 32))
+    eye = o @ o.T  # rows orthogonal, each scaled by `scale`
+    np.testing.assert_allclose(eye, 1.414 ** 2 * np.eye(32), atol=1e-3)
+
+
+def test_bilinear_upsampling_kernel():
+    b = _init_arr(init.Bilinear(), "up_weight", (1, 1, 4, 4))
+    assert abs(b[0, 0, 1, 1] - 0.5625) < 1e-6  # classic 4x4 bilinear kernel
+
+
+def test_lstmbias_forget_gate():
+    lb = init.LSTMBias(forget_bias=1.0)
+    arr = nd.zeros((20,))  # 4 gates × hidden 5; forget gate is slice [5:10]
+    lb(init.InitDesc("lstm_bias"), arr)
+    v = arr.asnumpy()
+    assert (v[5:10] == 1.0).all()
+    assert (v[:5] == 0).all() and (v[10:] == 0).all()
+
+
+def test_default_patterns_bias_zero_weight_random():
+    x = init.Xavier()
+    w = nd.zeros((10, 10))
+    b = nd.zeros((10,))
+    x(init.InitDesc("fc1_weight"), w)
+    x(init.InitDesc("fc1_bias"), b)
+    assert np.abs(w.asnumpy()).sum() > 0
+    assert (b.asnumpy() == 0).all()
+
+
+def test_mixed_initializer():
+    # suffix dispatch routes *_weight through _init_weight, so patterns
+    # choose between weight initializers (reference Mixed usage)
+    m = init.Mixed(["fc2_.*", ".*"], [init.One(), init.Zero()])
+    w1 = nd.array(np.full((4,), 7, np.float32))
+    w2 = nd.array(np.full((4,), 7, np.float32))
+    m(init.InitDesc("fc1_weight"), w1)
+    m(init.InitDesc("fc2_weight"), w2)
+    assert (w1.asnumpy() == 0).all()
+    assert (w2.asnumpy() == 1).all()

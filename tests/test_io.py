@@ -1,0 +1,117 @@
+"""Data iterator tests (reference tests/python/unittest/test_io.py):
+NDArrayIter pad/rollover/shuffle, CSVIter, ResizeIter, PrefetchingIter."""
+import os
+import tempfile
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def test_ndarrayiter_basic_and_pad():
+    X = np.arange(50, dtype=np.float32).reshape(10, 5)
+    y = np.arange(10, dtype=np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=4, label_name="softmax_label")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    seen = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert set(seen[:10].astype(int)) == set(range(10))
+
+
+def test_ndarrayiter_shuffle_covers_all():
+    X = np.arange(20, dtype=np.float32).reshape(20, 1)
+    it = mx.io.NDArrayIter(X, np.arange(20, dtype=np.float32), batch_size=5,
+                           shuffle=True, label_name="softmax_label")
+    lab = np.concatenate([b.label[0].asnumpy() for b in it])
+    assert sorted(lab.astype(int)) == list(range(20))
+    assert not np.array_equal(lab, np.arange(20))  # actually shuffled
+    it.reset()
+    lab2 = np.concatenate([b.label[0].asnumpy() for b in it])
+    assert sorted(lab2.astype(int)) == list(range(20))
+
+
+def test_ndarrayiter_last_batch_handle_discard():
+    X = np.zeros((10, 2), np.float32)
+    it = mx.io.NDArrayIter(X, np.arange(10, dtype=np.float32), batch_size=4,
+                           last_batch_handle="discard",
+                           label_name="softmax_label")
+    assert len(list(it)) == 2
+
+
+def test_ndarrayiter_dict_data():
+    it = mx.io.NDArrayIter({"a": np.zeros((8, 2), np.float32),
+                            "b": np.ones((8, 3), np.float32)},
+                           batch_size=4)
+    names = [d.name for d in it.provide_data]
+    assert sorted(names) == ["a", "b"]
+    batch = next(iter(it))
+    assert batch.data[0].shape[0] == 4
+
+
+def test_csviter_round_batch_modes():
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "d.csv")
+        np.savetxt(path, np.arange(30).reshape(10, 3), delimiter=",")
+        it = mx.io.CSVIter(data_csv=path, data_shape=(3,), batch_size=4)
+        batches = list(it)
+        assert batches[-1].pad == 2
+        assert batches[-1].data[0].shape == (4, 3)
+        # wrapped rows come from the start of the file
+        np.testing.assert_allclose(batches[-1].data[0].asnumpy()[2],
+                                   [0, 1, 2])
+        it2 = mx.io.CSVIter(data_csv=path, data_shape=(3,), batch_size=4,
+                            round_batch=False)
+        batches2 = list(it2)
+        assert batches2[-1].data[0].shape == (2, 3)  # truncated tail
+
+
+def test_mnistiter_tail_batch_padded():
+    import gzip
+    import struct
+
+    with tempfile.TemporaryDirectory() as tmp:
+        imgs = np.random.randint(0, 255, (10, 28, 28), dtype=np.uint8)
+        labs = np.arange(10, dtype=np.uint8)
+        ip = os.path.join(tmp, "img")
+        lp = os.path.join(tmp, "lab")
+        with open(ip, "wb") as f:
+            f.write(struct.pack(">I", 0x803) + struct.pack(">III", 10, 28, 28))
+            f.write(imgs.tobytes())
+        with open(lp, "wb") as f:
+            f.write(struct.pack(">I", 0x801) + struct.pack(">I", 10))
+            f.write(labs.tobytes())
+        it = mx.io.MNISTIter(image=ip, label=lp, batch_size=4)
+        batches = list(it)
+        assert len(batches) == 3
+        assert batches[-1].pad == 2
+        total = sum(b.label[0].shape[0] - b.pad for b in batches)
+        assert total == 10
+
+
+def test_resize_iter():
+    X = np.zeros((40, 2), np.float32)
+    base = mx.io.NDArrayIter(X, np.arange(40, dtype=np.float32), batch_size=4,
+                             label_name="softmax_label")
+    it = mx.io.ResizeIter(base, 3)
+    assert len(list(it)) == 3
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_prefetching_iter_matches_base():
+    X = np.arange(24, dtype=np.float32).reshape(12, 2)
+    y = np.arange(12, dtype=np.float32)
+
+    def collect(iterator):
+        return [b.label[0].asnumpy().copy() for b in iterator]
+
+    base = mx.io.NDArrayIter(X, y, batch_size=4, label_name="softmax_label")
+    ref = collect(base)
+    base.reset()
+    pf = mx.io.PrefetchingIter(base)
+    got = collect(pf)
+    assert len(ref) == len(got)
+    for a, b in zip(ref, got):
+        np.testing.assert_allclose(a, b)

@@ -1,0 +1,101 @@
+"""KVStore tests — local aggregation vs numpy with multiple device arrays
+(reference tests/python/unittest/test_kvstore.py, 125 LoC, SURVEY §4.3)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore
+from mxnet_tpu import ndarray as nd
+
+SHAPE = (4, 4)
+
+
+def test_single_kv_pair():
+    kv = kvstore.create("local")
+    kv.init(3, nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 1.0)
+
+
+def test_aggregate_push_pull():
+    """Push a list of 4 'device' arrays; pulled value must be their sum
+    (CommCPU/CommDevice reduce semantics, comm.h)."""
+    kv = kvstore.create("local")
+    kv.init(3, nd.zeros(SHAPE))
+    vals = [nd.array(np.full(SHAPE, i + 1, np.float32)) for i in range(4)]
+    kv.push(3, vals)
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 1 + 2 + 3 + 4)
+
+
+def test_updater_applied_on_push():
+    kv = kvstore.create("local")
+    kv.init(0, nd.ones(SHAPE))
+
+    def updater(key, grad, weight):
+        weight -= 0.5 * grad
+
+    kv.set_updater(updater)
+    kv.push(0, nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 0.5)
+
+
+def test_list_keys_and_multiple_pull_outs():
+    kv = kvstore.create("local")
+    keys = [5, 7, 9]
+    kv.init(keys, [nd.ones(SHAPE)] * 3)
+    kv.push(keys, [[nd.array(np.full(SHAPE, 2.0, np.float32))] for _ in keys])
+    outs = [nd.zeros(SHAPE) for _ in keys]
+    kv.pull(keys, out=outs)
+    for o in outs:
+        # no updater installed → push ASSIGNS the reduced value
+        # (reference kvstore_local.h:50-73)
+        np.testing.assert_allclose(o.asnumpy(), 2.0)
+
+
+def test_string_keys():
+    kv = kvstore.create("local")
+    kv.init("w", nd.zeros(SHAPE))
+    kv.push("w", nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 1.0)
+
+
+def test_set_optimizer_runs_fused_update():
+    kv = kvstore.create("local")
+    kv.init(0, nd.ones(SHAPE))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.0))
+    kv.push(0, nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 0.9, rtol=1e-6)
+
+
+def test_rank_and_size_local():
+    kv = kvstore.create("local")
+    assert kv.rank == 0 and kv.num_workers == 1
+
+
+def test_optimizer_state_save_load(tmp_path):
+    kv = kvstore.create("local")
+    kv.init(0, nd.ones(SHAPE))
+    kv.set_optimizer(mx.optimizer.Adam(learning_rate=0.01))
+    kv.push(0, nd.ones(SHAPE))
+    f = str(tmp_path / "states")
+    kv.save_optimizer_states(f)
+    cur = nd.zeros(SHAPE)
+    kv.pull(0, out=cur)  # resume = weights (checkpoint) + optimizer states
+    kv2 = kvstore.create("local")
+    kv2.init(0, cur)
+    kv2.set_optimizer(mx.optimizer.Adam(learning_rate=0.01))
+    kv2.load_optimizer_states(f)
+    kv.push(0, nd.ones(SHAPE))
+    kv2.push(0, nd.ones(SHAPE))
+    a, b = nd.zeros(SHAPE), nd.zeros(SHAPE)
+    kv.pull(0, out=a)
+    kv2.pull(0, out=b)
+    np.testing.assert_allclose(a.asnumpy(), b.asnumpy(), rtol=1e-6)

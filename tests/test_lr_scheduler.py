@@ -1,0 +1,28 @@
+"""LR scheduler tests (reference python/mxnet/lr_scheduler.py)."""
+import mxnet_tpu as mx
+
+
+def test_factor_scheduler():
+    # reference semantics: lr drops after num_update exceeds count+step
+    s = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 1.0
+    assert abs(s(5) - 1.0) < 1e-9
+    assert abs(s(10) - 1.0) < 1e-9
+    assert abs(s(11) - 0.5) < 1e-9
+    assert abs(s(25) - 0.25) < 1e-9
+
+
+def test_multifactor_scheduler():
+    s = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1)
+    s.base_lr = 1.0
+    assert abs(s(4) - 1.0) < 1e-9
+    assert abs(s(6) - 0.1) < 1e-9
+    assert abs(s(20) - 0.01) < 1e-9
+
+
+def test_poly_scheduler():
+    s = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0, pwr=1)
+    start = s(0)
+    mid = s(50)
+    end = s(100)
+    assert start > mid > end >= 0

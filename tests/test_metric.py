@@ -1,0 +1,88 @@
+"""Metric tests (reference tests/python/unittest/test_metric.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import metric as metric_mod
+from mxnet_tpu import ndarray as nd
+
+
+def test_accuracy():
+    m = metric_mod.Accuracy()
+    pred = nd.array(np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], np.float32))
+    lab = nd.array(np.array([1, 0, 0], np.float32))
+    m.update([lab], [pred])
+    assert abs(m.get()[1] - 2.0 / 3) < 1e-6
+
+
+def test_topk_accuracy():
+    m = metric_mod.TopKAccuracy(top_k=2)
+    pred = nd.array(np.array([[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]], np.float32))
+    lab = nd.array(np.array([1, 0], np.float32))
+    m.update([lab], [pred])
+    assert abs(m.get()[1] - 0.5) < 1e-6
+
+
+def test_f1():
+    m = metric_mod.F1()
+    pred = nd.array(np.array([[0.9, 0.1], [0.2, 0.8], [0.3, 0.7]], np.float32))
+    lab = nd.array(np.array([0, 1, 0], np.float32))
+    m.update([lab], [pred])
+    # TP=1 FP=1 FN=0 → precision=0.5 recall=1 → F1=2/3
+    assert abs(m.get()[1] - 2.0 / 3) < 1e-6
+
+
+def test_regression_metrics():
+    pred = nd.array(np.array([[1.0], [2.0], [3.0]], np.float32))
+    lab = nd.array(np.array([[2.0], [2.0], [5.0]], np.float32))
+    mae = metric_mod.MAE()
+    mae.update([lab], [pred])
+    assert abs(mae.get()[1] - 1.0) < 1e-6
+    mse = metric_mod.MSE()
+    mse.update([lab], [pred])
+    assert abs(mse.get()[1] - 5.0 / 3) < 1e-6
+    rmse = metric_mod.RMSE()
+    rmse.update([lab], [pred])
+    assert abs(rmse.get()[1] - np.sqrt(5.0 / 3)) < 1e-5
+
+
+def test_cross_entropy_and_perplexity():
+    pred = nd.array(np.array([[0.25, 0.75], [0.9, 0.1]], np.float32))
+    lab = nd.array(np.array([1, 0], np.float32))
+    ce = metric_mod.CrossEntropy()
+    ce.update([lab], [pred])
+    ref = -(np.log(0.75) + np.log(0.9)) / 2
+    assert abs(ce.get()[1] - ref) < 1e-5
+    pp = metric_mod.Perplexity(ignore_label=None)
+    pp.update([lab], [pred])
+    assert abs(pp.get()[1] - np.exp(ref)) < 1e-4
+
+
+def test_composite_and_reset():
+    m = metric_mod.CompositeEvalMetric(
+        metrics=[metric_mod.Accuracy(), metric_mod.MSE()])
+    pred = nd.array(np.array([[0.1, 0.9]], np.float32))
+    lab = nd.array(np.array([1], np.float32))
+    m.update([lab], [pred])
+    names, vals = m.get()
+    assert len(names) == 2 and len(vals) == 2
+    m.reset()
+    for v in m.get()[1]:
+        assert np.isnan(v) or v == 0
+
+
+def test_custom_metric_np():
+    def top_error(label, pred):
+        return float((pred.argmax(1) != label).mean())
+
+    m = metric_mod.np(top_error)
+    pred = nd.array(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+    lab = nd.array(np.array([0, 0], np.float32))
+    m.update([lab], [pred])
+    assert abs(m.get()[1] - 0.5) < 1e-6
+
+
+def test_create_by_name():
+    m = metric_mod.create("acc")
+    assert isinstance(m, metric_mod.Accuracy)
+    m2 = metric_mod.create(["acc", "mse"])
+    assert isinstance(m2, metric_mod.CompositeEvalMetric)

@@ -1,0 +1,150 @@
+"""Module training-stack tests (reference tests/python/unittest/test_module.py
+265 LoC + tests/python/train convergence suite, SURVEY §4.2/§4.5)."""
+import os
+import tempfile
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def _blobs(n=600, d=10, k=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    W = rng.randn(d, k).astype(np.float32) * 2
+    y = (X @ W).argmax(1).astype(np.float32)
+    return X, y
+
+
+def _mlp(k=3):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=k, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_fit_converges_and_scores():
+    X, y = _blobs()
+    it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=6, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2})
+    it.reset()
+    acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+    assert acc > 0.9, acc
+
+
+def test_module_predict_shapes():
+    X, y = _blobs(n=70)
+    it = mx.io.NDArrayIter(X, y, batch_size=32, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    out = mod.predict(it)
+    assert out.shape == (70, 3)  # pad stripped from the tail batch
+
+
+def test_save_load_checkpoint_with_optimizer_states():
+    X, y = _blobs(n=128)
+    it = mx.io.NDArrayIter(X, y, batch_size=32, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01})
+    with tempfile.TemporaryDirectory() as tmp:
+        prefix = os.path.join(tmp, "chk")
+        mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+        assert os.path.exists(prefix + "-symbol.json")
+        assert os.path.exists(prefix + "-0001.params")
+        assert os.path.exists(prefix + "-0001.states")
+        mod2 = mx.mod.Module.load(prefix, 1, load_optimizer_states=True,
+                                  context=mx.cpu())
+        it.reset()
+        mod2.fit(it, num_epoch=1, optimizer="adam",
+                 optimizer_params={"learning_rate": 0.01})
+
+
+def test_module_multi_device_matches_single():
+    """4-CPU-device data parallel must match single-device numerically
+    (deterministic SGD, same init) — the multi-device-without-hardware
+    strategy of SURVEY §4.3."""
+    X, y = _blobs(n=256)
+    k = 3
+
+    def run(ctx):
+        it = mx.io.NDArrayIter(X, y, batch_size=64, shuffle=False,
+                               label_name="softmax_label")
+        mod = mx.mod.Module(_mlp(k), context=ctx)
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(mx.initializer.Constant(0.05))
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        for _ in range(2):
+            it.reset()
+            for batch in it:
+                mod.forward_backward(batch)
+                mod.update()
+        return {k_: v.asnumpy() for k_, v in mod.get_params()[0].items()}
+
+    single = run(mx.cpu())
+    multi = run([mx.cpu(i) for i in range(4)])
+    for name in single:
+        np.testing.assert_allclose(single[name], multi[name],
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_bucketing_module():
+    """Variable-length training via sym_gen per bucket (reference
+    module/bucketing_module.py + lstm_bucketing example)."""
+    vocab, k = 20, 5
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=8,
+                               name="emb")
+        flat = mx.sym.Flatten(emb)
+        fc = mx.sym.FullyConnected(flat, num_hidden=k, name="fc")
+        sm = mx.sym.SoftmaxOutput(fc, name="softmax")
+        return sm, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
+    rng = np.random.RandomState(0)
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd")
+    for seq_len in [8, 4, 8, 6]:
+        data = rng.randint(0, vocab, (4, seq_len)).astype(np.float32)
+        label = rng.randint(0, k, (4,)).astype(np.float32)
+        batch = mx.io.DataBatch([nd.array(data)], [nd.array(label)],
+                                bucket_key=seq_len,
+                                provide_data=[("data", (4, seq_len))],
+                                provide_label=[("softmax_label", (4,))])
+        mod.forward_backward(batch)
+        mod.update()
+    assert len(mod._buckets) >= 3  # per-bucket executors created
+
+
+def test_sequential_module():
+    X, y = _blobs(n=64)
+    net1 = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                 name="fc1")
+    net1 = mx.sym.Activation(net1, act_type="relu")
+    net2 = mx.sym.FullyConnected(mx.sym.Variable("fc1_relu_output"),
+                                 num_hidden=3, name="fc2")
+    net2 = mx.sym.SoftmaxOutput(net2, name="softmax")
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(net1, data_names=["data"], label_names=[]))
+    seq.add(mx.mod.Module(net2, data_names=["fc1_relu_output"],
+                          label_names=["softmax_label"]),
+            take_labels=True, auto_wiring=True)
+    it = mx.io.NDArrayIter(X, y, batch_size=16, label_name="softmax_label")
+    seq.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    seq.init_params(mx.initializer.Xavier())
+    seq.init_optimizer(optimizer="sgd")
+    batch = next(iter(it))
+    seq.forward(batch)
+    out = seq.get_outputs()[0]
+    assert out.shape == (16, 3)

@@ -1,0 +1,149 @@
+"""Optimizer tests — fused update ops checked against straight-line numpy
+reference updaters, the reference's test strategy
+(tests/python/unittest/test_optimizer.py, 356 LoC: compares sgd/adam
+kernels against Python reference implementations)."""
+import pickle
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import optimizer as opt
+
+
+def _run_updates(optimizer, w0, grads):
+    """Drive an optimizer through len(grads) updates, return final weight."""
+    upd = opt.get_updater(optimizer)
+    w = nd.array(w0.copy())
+    for g in grads:
+        upd(0, nd.array(g), w)
+    return w.asnumpy()
+
+
+RNG = np.random.RandomState(0)
+W0 = RNG.randn(5, 4).astype(np.float32)
+GRADS = [RNG.randn(5, 4).astype(np.float32) for _ in range(4)]
+
+
+def test_sgd_matches_numpy():
+    lr, mom, wd = 0.1, 0.9, 0.01
+    out = _run_updates(opt.SGD(learning_rate=lr, momentum=mom, wd=wd), W0, GRADS)
+    w = W0.copy()
+    m = np.zeros_like(w)
+    for g in GRADS:
+        m = mom * m - lr * (g + wd * w)
+        w = w + m
+    np.testing.assert_allclose(out, w, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_no_momentum_matches_numpy():
+    lr, wd = 0.05, 0.0
+    out = _run_updates(opt.SGD(learning_rate=lr, momentum=0.0, wd=wd), W0, GRADS)
+    w = W0.copy()
+    for g in GRADS:
+        w = w - lr * g
+    np.testing.assert_allclose(out, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_matches_numpy():
+    lr, b1, b2, eps, wd = 0.01, 0.9, 0.999, 1e-8, 0.0
+    out = _run_updates(
+        opt.Adam(learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps, wd=wd),
+        W0, GRADS)
+    w = W0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t, g in enumerate(GRADS, 1):
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        w = w - lr_t * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(out, w, rtol=1e-4, atol=1e-5)
+
+
+def test_rmsprop_matches_numpy():
+    lr, rho, eps = 0.01, 0.95, 1e-8
+    o = opt.RMSProp(learning_rate=lr, gamma1=rho, epsilon=eps,
+                    centered=False)
+    out = _run_updates(o, W0, GRADS)
+    w = W0.copy()
+    n = np.zeros_like(w)
+    for g in GRADS:
+        n = rho * n + (1 - rho) * g * g
+        w = w - lr * g / (np.sqrt(n) + eps)
+    np.testing.assert_allclose(out, w, rtol=1e-4, atol=1e-5)
+
+
+def test_rescale_grad_and_clip():
+    lr = 0.1
+    o = opt.SGD(learning_rate=lr, momentum=0.0, wd=0.0,
+                rescale_grad=0.5, clip_gradient=0.05)
+    out = _run_updates(o, W0, GRADS[:1])
+    ref = W0 - lr * np.clip(GRADS[0] * 0.5, -0.05, 0.05)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_lr_wd_mult_by_name():
+    o = opt.SGD(learning_rate=0.1, momentum=0.0, wd=0.0)
+    o.set_lr_mult({"fc_weight": 0.0})
+    o.idx2name = {0: "fc_weight"}
+    out = _run_updates(o, W0, GRADS[:2])
+    np.testing.assert_allclose(out, W0)  # lr_mult=0 freezes the weight
+
+
+def test_updater_state_roundtrip():
+    o = opt.Adam(learning_rate=0.01)
+    upd = opt.get_updater(o)
+    w = nd.array(W0.copy())
+    upd(0, nd.array(GRADS[0]), w)
+    blob = upd.get_states()
+    upd2 = opt.get_updater(opt.Adam(learning_rate=0.01))
+    upd2.set_states(blob)
+    # continue both and compare
+    w2 = nd.array(w.asnumpy())
+    upd(0, nd.array(GRADS[1]), w)
+    upd2(0, nd.array(GRADS[1]), w2)
+    np.testing.assert_allclose(w.asnumpy(), w2.asnumpy(), rtol=1e-6)
+
+
+def test_create_by_name():
+    for name in ["sgd", "adam", "rmsprop", "adagrad", "adadelta", "ftrl",
+                 "nag", "sgld", "dcasgd"]:
+        o = opt.create(name, learning_rate=0.1)
+        out = _run_updates(o, W0, GRADS[:2])
+        assert out.shape == W0.shape
+        assert np.isfinite(out).all()
+        assert not np.allclose(out, W0)  # it moved
+
+
+def test_fused_update_ops_match_optimizer():
+    """The registry's fused kernels (optimizer_op.cc analogues) must agree
+    with the Optimizer classes that wrap them."""
+    w = nd.array(W0.copy())
+    g = nd.array(GRADS[0])
+    out = nd.sgd_update(w, g, lr=0.1, wd=0.0, rescale_grad=1.0)
+    np.testing.assert_allclose(out.asnumpy(), W0 - 0.1 * GRADS[0],
+                               rtol=1e-5, atol=1e-6)
+    mom = nd.zeros(W0.shape)
+    out2 = nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9, wd=0.0,
+                             rescale_grad=1.0)
+    new_w = out2[0] if isinstance(out2, (list, tuple)) else out2
+    np.testing.assert_allclose(new_w.asnumpy(), W0 - 0.1 * GRADS[0],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_scheduler_in_optimizer():
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    o = opt.SGD(learning_rate=0.1, momentum=0.0, lr_scheduler=sched)
+    upd = opt.get_updater(o)
+    w = nd.array(np.zeros((2,), np.float32))
+    g = nd.array(np.ones((2,), np.float32))
+    deltas = []
+    prev = w.asnumpy().copy()
+    for _ in range(5):
+        upd(0, g, w)
+        cur = w.asnumpy().copy()
+        deltas.append(abs((cur - prev)[0]))
+        prev = cur
+    assert deltas[0] > deltas[-1]  # lr decayed

@@ -1,0 +1,128 @@
+"""RNN cell tests (reference tests/python/unittest/test_rnn.py): unroll
+shapes, fused/unfused equivalence, modifier cells."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.rnn import rnn_cell
+
+
+def _run_sym(sym, shapes, seed=0):
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", **shapes)
+    rng = np.random.RandomState(seed)
+    for name, arr in exe.arg_dict.items():
+        arr[:] = rng.uniform(-0.1, 0.1, arr.shape).astype(np.float32)
+    return exe.forward(is_train=False), exe
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = rnn_cell.RNNCell(10, prefix="rnn_")
+    outputs, _ = cell.unroll(3, input_prefix="t_")
+    net = mx.sym.Group(outputs)
+    outs, _ = _run_sym(net, {"t_t%d_data" % i: (2, 7) for i in range(3)})
+    assert len(outs) == 3
+    assert outs[0].shape == (2, 10)
+
+
+def test_lstm_cell_unroll_and_state():
+    cell = rnn_cell.LSTMCell(8, prefix="lstm_")
+    outputs, states = cell.unroll(4, input_prefix="x_")
+    assert len(outputs) == 4 and len(states) == 2
+    net = mx.sym.Group(outputs)
+    outs, _ = _run_sym(net, {"x_t%d_data" % i: (3, 5) for i in range(4)})
+    assert outs[-1].shape == (3, 8)
+
+
+def test_gru_cell_runs():
+    cell = rnn_cell.GRUCell(6, prefix="gru_")
+    outputs, _ = cell.unroll(2, input_prefix="x_")
+    outs, _ = _run_sym(mx.sym.Group(outputs),
+                       {"x_t%d_data" % i: (2, 4) for i in range(2)})
+    assert outs[0].shape == (2, 6)
+
+
+def test_fused_cell_unfuse_equivalence():
+    """FusedRNNCell must agree with its unfuse()d explicit-cell stack —
+    the reference's cuDNN-vs-explicit consistency check
+    (tests/python/gpu/test_operator_gpu.py RNN section)."""
+    T, B, D, H = 3, 2, 4, 5
+    fused = rnn_cell.FusedRNNCell(H, num_layers=1, mode="lstm",
+                                  prefix="f_", get_next_state=True)
+    outputs_f, _ = fused.unroll(T, input_prefix="x_", merge_outputs=True)
+    sym_f = outputs_f if not isinstance(outputs_f, list) else mx.sym.Group(outputs_f)
+
+    unfused = fused.unfuse()
+    outputs_u, _ = unfused.unroll(T, input_prefix="x_")
+    sym_u = mx.sym.Group(outputs_u)
+
+    shapes = {"x_t%d_data" % i: (B, D) for i in range(T)}
+    rng = np.random.RandomState(3)
+    exe_f = sym_f.simple_bind(mx.cpu(), grad_req="null", **shapes)
+    vals = {n: rng.uniform(-0.2, 0.2, a.shape).astype(np.float32)
+            for n, a in exe_f.arg_dict.items()}
+    for n, a in exe_f.arg_dict.items():
+        a[:] = vals[n]
+    out_f = exe_f.forward(is_train=False)[0].asnumpy()
+
+    # map the packed blob into the unfused per-layer params via the cell's
+    # own slicing (reference _slice_weights contract)
+    blob = vals["f_parameters"]
+    sliced = fused._slice_weights(blob, D, fused._num_hidden)
+    exe_u = sym_u.simple_bind(mx.cpu(), grad_req="null", **shapes)
+    gates = fused._gate_names
+    for n, a in exe_u.arg_dict.items():
+        if n in vals:
+            a[:] = vals[n]
+            continue
+        # n like "f_l0_i2h_weight" → concat of per-gate slices
+        for part in ("i2h", "h2h"):
+            for kind in ("weight", "bias"):
+                suffix = "_%s_%s" % (part, kind)
+                if n.endswith(suffix):
+                    base = n[: -len(suffix)]
+                    pieces = [sliced["%s_%s%s_%s" % (base, part, g, kind)]
+                              for g in gates]
+                    a[:] = np.concatenate([np.asarray(p) for p in pieces],
+                                          axis=0)
+    out_u = np.stack([o.asnumpy() for o in exe_u.forward(is_train=False)],
+                     axis=0)  # (T, B, H)
+    out_f_t = out_f if out_f.shape[0] == T else out_f.transpose(1, 0, 2)
+    np.testing.assert_allclose(out_f_t, out_u, rtol=1e-4, atol=1e-5)
+
+
+def test_bidirectional_cell():
+    cell = rnn_cell.BidirectionalCell(
+        rnn_cell.RNNCell(4, prefix="l_"),
+        rnn_cell.RNNCell(4, prefix="r_"))
+    outputs, _ = cell.unroll(3, input_prefix="x_")
+    outs, _ = _run_sym(mx.sym.Group(outputs),
+                       {"x_t%d_data" % i: (2, 3) for i in range(3)})
+    assert outs[0].shape == (2, 8)  # fwd & bwd concat
+
+
+def test_residual_and_dropout_cells():
+    cell = rnn_cell.SequentialRNNCell()
+    cell.add(rnn_cell.RNNCell(6, prefix="a_"))
+    cell.add(rnn_cell.ResidualCell(rnn_cell.RNNCell(6, prefix="b_")))
+    cell.add(rnn_cell.DropoutCell(0.0))
+    outputs, _ = cell.unroll(2, input_prefix="x_")
+    outs, _ = _run_sym(mx.sym.Group(outputs),
+                       {"x_t%d_data" % i: (2, 6) for i in range(2)})
+    assert outs[0].shape == (2, 6)
+
+
+def test_rnn_op_forward_shapes():
+    """The fused RNN op (reference cuDNN RNN analogue, ops/rnn_fused.py)."""
+    T, B, D, H = 4, 2, 3, 5
+    x = nd.array(np.random.randn(T, B, D).astype(np.float32))
+    g = 3  # gru gates
+    n_params = 0
+    for layer in range(2):
+        ni = D if layer == 0 else H
+        n_params += g * H * ni + g * H * H  # i2h + h2h weights
+        n_params += 2 * g * H  # i2h + h2h biases
+    params = nd.array(np.random.uniform(-0.1, 0.1, (n_params,)).astype(np.float32))
+    state = nd.zeros((2, B, H))
+    out = nd.RNN(x, params, state, state_size=H, num_layers=2, mode="gru")
+    first = out[0] if isinstance(out, (list, tuple)) else out
+    assert first.shape == (T, B, H)

@@ -1,0 +1,100 @@
+"""mx.rtc (runtime user kernels) and mx.predict (deployment API) tests —
+reference analogues: tests/python/gpu/test_rtc.py and the c_predict_api
+surface (SURVEY §2.1 #30, #31)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def test_rtc_pallas_kernel():
+    rtc = mx.rtc.create("axpy", ["x", "y"], ["out"], """
+    def kernel(x_ref, y_ref, out_ref):
+        out_ref[...] = x_ref[...] * 2.0 + y_ref[...]
+    """)
+    x = nd.array(np.random.randn(8, 16).astype(np.float32))
+    y = nd.array(np.random.randn(8, 16).astype(np.float32))
+    out = nd.zeros((8, 16))
+    rtc.push([x, y], [out])
+    np.testing.assert_allclose(out.asnumpy(), 2 * x.asnumpy() + y.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_rtc_source_cache():
+    src = """
+    def kernel(x_ref, out_ref):
+        out_ref[...] = x_ref[...] + 1.0
+    """
+    a = mx.rtc.create("inc", ["x"], ["out"], src)
+    b = mx.rtc.create("inc", ["x"], ["out"], src)
+    assert a is b  # cached by source hash (reference mxrtc.h:26-40)
+
+
+def test_rtc_jax_mode():
+    rtc = mx.rtc.create("relu", ["x"], ["out"], """
+    def fn(x):
+        return jnp.maximum(x, 0.0)
+    """, mode="jax")
+    x = nd.array(np.array([[-1.0, 2.0]], np.float32))
+    out = nd.zeros((1, 2))
+    rtc.push([x], [out])
+    np.testing.assert_allclose(out.asnumpy(), [[0.0, 2.0]])
+
+
+def test_rtc_bad_source_raises():
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.create("bad", ["x"], ["o"], "def not_kernel(): pass")
+
+
+def _make_checkpoint(tmp):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))], label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Xavier())
+    prefix = os.path.join(tmp, "m")
+    mod.save_checkpoint(prefix, 1)
+    return prefix, mod
+
+
+def test_predictor_matches_module():
+    with tempfile.TemporaryDirectory() as tmp:
+        prefix, mod = _make_checkpoint(tmp)
+        x = np.random.randn(4, 10).astype(np.float32)
+        pred = mx.predict.create(prefix, 1, {"data": (4, 10)})
+        out = pred.forward(data=x)[0].asnumpy()
+        mod.forward(mx.io.DataBatch([nd.array(x)], []), is_train=False)
+        ref = mod.get_outputs()[0].asnumpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_reshape():
+    with tempfile.TemporaryDirectory() as tmp:
+        prefix, _ = _make_checkpoint(tmp)
+        pred = mx.predict.create(prefix, 1, {"data": (4, 10)})
+        p2 = pred.reshape({"data": (7, 10)})
+        out = p2.forward(data=np.zeros((7, 10), np.float32))[0]
+        assert out.shape == (7, 3)
+        with pytest.raises(mx.MXNetError):
+            pred.forward(data=np.zeros((5, 10), np.float32))
+
+
+def test_predictor_export_roundtrip():
+    with tempfile.TemporaryDirectory() as tmp:
+        prefix, _ = _make_checkpoint(tmp)
+        pred = mx.predict.create(prefix, 1, {"data": (4, 10)})
+        x = np.random.randn(4, 10).astype(np.float32)
+        ref = pred.forward(data=x)[0].asnumpy()
+        art = os.path.join(tmp, "artifact")
+        pred.export(art)
+        assert os.path.exists(os.path.join(art, "model.stablehlo"))
+        loaded = mx.predict.load(art)
+        out = loaded.forward(data=x)[0].asnumpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-6)

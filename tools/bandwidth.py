@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Collective bus-bandwidth measurement harness.
+
+TPU-native analogue of the reference's tools/bandwidth/ kvstore
+bus-bandwidth tool (cited by docs/how_to/perf.md "Multiple Devices"):
+measures the all-reduce bandwidth the gradient-sync path actually achieves
+over a mesh axis (ICI on a slice; ICI+DCN across hosts), for a sweep of
+message sizes. The reference's guidance applies unchanged: per-batch
+communication time must stay below per-batch compute time.
+
+  python tools/bandwidth.py                   # defaults: data axis, 1-256MB
+  python tools/bandwidth.py --sizes-mb 4 64 --axis data
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--axis", default="data")
+    ap.add_argument("--sizes-mb", type=float, nargs="+",
+                    default=[1, 4, 16, 64, 256])
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--json", action="store_true",
+                    help="print one JSON line instead of a table")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from mxnet_tpu.parallel import collectives
+
+    devs = jax.devices()
+    mesh = Mesh(jax.numpy.array(devs).reshape(len(devs)), (args.axis,))
+    rows = []
+    for mb in args.sizes_mb:
+        gbps = collectives.bus_bandwidth(mesh, args.axis, size_mb=mb,
+                                         iters=args.iters,
+                                         dtype=jnp.dtype(args.dtype))
+        rows.append({"size_mb": mb, "bus_gbps": round(gbps, 3)})
+    if args.json:
+        print(json.dumps({"devices": len(devs), "axis": args.axis,
+                          "results": rows}))
+    else:
+        print("devices=%d axis=%s dtype=%s" % (len(devs), args.axis,
+                                               args.dtype))
+        print("%10s %12s" % ("size(MB)", "bus GB/s"))
+        for r in rows:
+            print("%10g %12.3f" % (r["size_mb"], r["bus_gbps"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Cluster launcher.
+
+TPU-native analogue of the reference's tools/launch.py (which delegates to
+dmlc-core trackers: local/ssh/mpi/sge/yarn — tools/launch.py:33-60,
+SURVEY §2.7). The reference starts scheduler + server + worker OS
+processes; here every process is a worker and the "scheduler" is the
+jax.distributed coordinator (SURVEY §5.8), so launching means: start N
+copies of the training script with MXNET_TPU_{COORDINATOR,NUM_PROCS,
+PROC_ID} set, then `mxnet_tpu.parallel.dist.init()` inside the script wires
+them into one mesh.
+
+Modes:
+  --launcher local  spawn N local processes (the dmlc "local" tracker;
+                    multi-process CPU emulation or one-host multi-chip)
+  --launcher ssh    one process per host listed in --hostfile
+                    (the dmlc "ssh" tracker)
+  --launcher tpu    print the gcloud command that runs the script on every
+                    worker of a TPU pod slice (pods launch via the cloud
+                    CLI, not raw ssh)
+
+Example:
+  python tools/launch.py -n 4 --launcher local python train.py --epochs 1
+"""
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+
+
+def launch_local(n, cmd, env_extra=None):
+    """Local multi-process launch (dmlc local tracker analogue)."""
+    procs = []
+    coord = "127.0.0.1:%d" % int(os.environ.get("MXNET_TPU_PORT", "12975"))
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env["MXNET_TPU_COORDINATOR"] = coord
+        env["MXNET_TPU_NUM_PROCS"] = str(n)
+        env["MXNET_TPU_PROC_ID"] = str(rank)
+        procs.append(subprocess.Popen(cmd, env=env))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+def launch_ssh(hosts, cmd, repo_dir):
+    """One process per host over ssh (dmlc ssh tracker analogue)."""
+    coord = "%s:%d" % (hosts[0], int(os.environ.get("MXNET_TPU_PORT",
+                                                    "12975")))
+    procs = []
+    for rank, host in enumerate(hosts):
+        envs = ("MXNET_TPU_COORDINATOR=%s MXNET_TPU_NUM_PROCS=%d "
+                "MXNET_TPU_PROC_ID=%d" % (coord, len(hosts), rank))
+        remote = "cd %s && %s %s" % (shlex.quote(repo_dir), envs,
+                                     " ".join(shlex.quote(c) for c in cmd))
+        procs.append(subprocess.Popen(["ssh", "-o",
+                                       "StrictHostKeyChecking=no", host,
+                                       remote]))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+def launch_tpu_pod(args, cmd):
+    """Print the pod-slice launch command; TPU pods are driven by the cloud
+    CLI (every worker runs the same script; jax initializes from pod
+    metadata, no MXNET_TPU_* env needed)."""
+    joined = " ".join(shlex.quote(c) for c in cmd)
+    print("# Run on every worker of the pod slice:")
+    print("gcloud compute tpus tpu-vm ssh %s --worker=all "
+          "--command=%s" % (args.tpu_name or "$TPU_NAME",
+                            shlex.quote("cd %s && %s"
+                                        % (os.getcwd(), joined))))
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, default=1)
+    ap.add_argument("--launcher", choices=["local", "ssh", "tpu"],
+                    default="local")
+    ap.add_argument("--hostfile", help="one host per line (ssh launcher)")
+    ap.add_argument("--tpu-name", help="TPU pod name (tpu launcher)")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+    cmd = args.command
+    if args.launcher == "local":
+        sys.exit(launch_local(args.num_workers, cmd))
+    elif args.launcher == "ssh":
+        if not args.hostfile:
+            ap.error("--hostfile required for ssh launcher")
+        with open(args.hostfile) as f:
+            hosts = [h.strip() for h in f if h.strip()]
+        sys.exit(launch_ssh(hosts[:args.num_workers] if args.num_workers > 1
+                            else hosts, cmd, os.getcwd()))
+    else:
+        sys.exit(launch_tpu_pod(args, cmd))
+
+
+if __name__ == "__main__":
+    main()

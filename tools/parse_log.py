@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Parse training logs into per-epoch tables (reference tools/parse_log.py).
+
+Reads logs produced by Module.fit / Speedometer lines like:
+  Epoch[0] Batch [50]  Speed: 4321.0 samples/sec  accuracy=0.91
+  Epoch[0] Train-accuracy=0.93
+  Epoch[0] Validation-accuracy=0.90
+  Epoch[0] Time cost=12.3
+
+  python tools/parse_log.py train.log [--format csv|md]
+"""
+import argparse
+import re
+import sys
+
+
+EPOCH_METRIC = re.compile(
+    r"Epoch\[(\d+)\]\s+(Train|Validation)-([\w-]+)=([0-9.eE+-]+)")
+EPOCH_TIME = re.compile(r"Epoch\[(\d+)\]\s+Time cost=([0-9.eE+-]+)")
+SPEED = re.compile(
+    r"Epoch\[(\d+)\].*Speed:\s*([0-9.eE+-]+)\s*samples/sec")
+
+
+def parse(lines):
+    epochs = {}
+    for line in lines:
+        m = EPOCH_METRIC.search(line)
+        if m:
+            e = int(m.group(1))
+            key = "%s-%s" % (m.group(2).lower(), m.group(3))
+            epochs.setdefault(e, {})[key] = float(m.group(4))
+            continue
+        m = EPOCH_TIME.search(line)
+        if m:
+            epochs.setdefault(int(m.group(1)), {})["time"] = float(m.group(2))
+            continue
+        m = SPEED.search(line)
+        if m:
+            e = int(m.group(1))
+            d = epochs.setdefault(e, {})
+            d.setdefault("_speeds", []).append(float(m.group(2)))
+    for d in epochs.values():
+        sp = d.pop("_speeds", None)
+        if sp:
+            d["speed"] = sum(sp) / len(sp)
+    return epochs
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile", nargs="?", default="-")
+    ap.add_argument("--format", choices=["csv", "md"], default="md")
+    args = ap.parse_args()
+    f = sys.stdin if args.logfile == "-" else open(args.logfile)
+    epochs = parse(f)
+    if not epochs:
+        print("no epochs found", file=sys.stderr)
+        return
+    cols = sorted({k for d in epochs.values() for k in d})
+    if args.format == "csv":
+        print(",".join(["epoch"] + cols))
+        for e in sorted(epochs):
+            print(",".join([str(e)] + ["%g" % epochs[e].get(c, float("nan"))
+                                       for c in cols]))
+    else:
+        print("| epoch | " + " | ".join(cols) + " |")
+        print("|" + "---|" * (len(cols) + 1))
+        for e in sorted(epochs):
+            print("| %d | " % e + " | ".join(
+                "%g" % epochs[e].get(c, float("nan")) for c in cols) + " |")
+
+
+if __name__ == "__main__":
+    main()
