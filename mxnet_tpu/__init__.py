@@ -34,6 +34,8 @@ from . import visualization as viz
 from . import test_utils
 from . import model
 from .model import FeedForward
+from . import executor_manager
+from . import kvstore_server
 from . import operator
 from . import models
 from . import recordio
@@ -42,3 +44,16 @@ from . import predict
 from . import engine
 from . import rnn
 from . import profiler
+
+
+def __getattr__(name):
+    # Lazy heavy/optional plugins: mx.torch (PyTorch foreign-kernel seam,
+    # torch.py) is only imported on first touch, like the reference's
+    # opt-in Torch plugin (plugin/torch, make/config.mk TORCH_PATH).
+    if name in ("torch", "th"):
+        import importlib
+
+        m = importlib.import_module(".torch", __name__)
+        globals()["torch"] = globals()["th"] = m
+        return m
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

@@ -1,0 +1,161 @@
+"""Legacy data-parallel executor management (pre-Module API).
+
+Parity with python/mxnet/executor_manager.py (SURVEY §2.4): the
+`FeedForward` estimator's device-management layer — `_split_input_slice`
+(workload-weighted batch slicing, executor_manager.py:14) and
+`DataParallelExecutorManager` which binds one executor per context and
+fans a batch out / gradients back.
+
+TPU-native note: the modern path (module/executor_group.py) shards the
+batch over a jax mesh in ONE executor, which splits evenly by
+construction; non-uniform work_load_list values are therefore reported
+(warning + even slices) rather than honored — on a homogeneous TPU mesh
+uneven device weighting has no use. `_split_input_slice` itself keeps the
+reference's exact weighted-slice arithmetic for callers that shard on the
+host. Binding delegates to DataParallelExecutorGroup.
+"""
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .base import MXNetError
+from .io import DataDesc
+from .module.executor_group import DataParallelExecutorGroup
+
+
+def _split_input_slice(batch_size: int,
+                       work_load_list: Sequence[float]) -> List[slice]:
+    """Split batch_size into per-device slices proportional to the
+    workload weights (reference _split_input_slice,
+    executor_manager.py:14-43). Raises if a device would get 0 rows."""
+    total = sum(work_load_list)
+    if total <= 0:
+        raise MXNetError("invalid work load list %r" % (work_load_list,))
+    slices = []
+    start = 0
+    acc = 0.0
+    for i, w in enumerate(work_load_list):
+        acc += w
+        end = (batch_size if i == len(work_load_list) - 1
+               else int(round(batch_size * acc / total)))
+        if end <= start:
+            raise MXNetError(
+                "too many slices: batch size %d cannot cover workload %r"
+                % (batch_size, work_load_list))
+        slices.append(slice(start, end))
+        start = end
+    return slices
+
+
+def _check_arguments(symbol):
+    """Reject duplicated argument/aux names (reference _check_arguments)."""
+    arg_names = symbol.list_arguments()
+    if len(set(arg_names)) != len(arg_names):
+        dup = [n for n in arg_names if arg_names.count(n) > 1]
+        raise MXNetError("find duplicated argument name %r" % (dup,))
+    aux_names = symbol.list_auxiliary_states()
+    if len(set(aux_names)) != len(aux_names):
+        dup = [n for n in aux_names if aux_names.count(n) > 1]
+        raise MXNetError("find duplicated auxiliary name %r" % (dup,))
+
+
+class DataParallelExecutorManager:
+    """Helper to manage multiple executors for data parallelism (reference
+    executor_manager.py:195 DataParallelExecutorManager). Used by the
+    legacy FeedForward path; Module uses DataParallelExecutorGroup
+    directly."""
+
+    def __init__(self, symbol, ctx, train_data, arg_names=None,
+                 param_names=None, aux_names=None, work_load_list=None,
+                 logger=None, sym_gen=None):
+        self.logger = logger or logging
+        self.symbol = symbol
+        self.ctx = ctx if isinstance(ctx, (list, tuple)) else [ctx]
+        self.sym_gen = sym_gen
+        _check_arguments(symbol)
+
+        if work_load_list is None:
+            work_load_list = [1.0] * len(self.ctx)
+        if len(work_load_list) != len(self.ctx):
+            raise MXNetError("Invalid setting for work load.")
+        self.work_load_list = list(work_load_list)
+
+        batch_size = train_data.provide_data[0][1][0] \
+            if not hasattr(train_data.provide_data[0], "shape") \
+            else train_data.provide_data[0].shape[0]
+        if len(set(self.work_load_list)) > 1:
+            self.logger.warning(
+                "non-uniform work_load_list %r is not honored: the mesh-"
+                "sharded executor splits the batch evenly across devices",
+                self.work_load_list)
+            self.slices = _split_input_slice(batch_size,
+                                             [1.0] * len(self.ctx))
+        else:
+            self.slices = _split_input_slice(batch_size, self.work_load_list)
+
+        self.arg_names = arg_names or symbol.list_arguments()
+        self.aux_names = aux_names or symbol.list_auxiliary_states()
+        data_names = [d[0] if isinstance(d, tuple) else d.name
+                      for d in train_data.provide_data]
+        label_names = [d[0] if isinstance(d, tuple) else d.name
+                       for d in train_data.provide_label]
+        if param_names is None:
+            param_names = [n for n in self.arg_names
+                           if n not in data_names + label_names]
+        self.param_names = list(param_names)
+
+        def _desc(d):
+            if isinstance(d, tuple):
+                return DataDesc(d[0], d[1])
+            return d
+
+        self.execgrp = DataParallelExecutorGroup(
+            symbol, self.ctx, self.work_load_list,
+            [_desc(d) for d in train_data.provide_data],
+            [_desc(d) for d in train_data.provide_label],
+            self.param_names, for_training=True, inputs_need_grad=False,
+            logger=self.logger)
+        self._monitor = None
+
+    # ---- parameter plumbing (reference :268-306) -------------------------
+    def install_monitor(self, monitor):
+        self._monitor = monitor
+        self.execgrp.install_monitor(monitor)
+
+    def set_params(self, arg_params, aux_params):
+        self.execgrp.set_params(arg_params, aux_params)
+
+    def copy_to(self, arg_params, aux_params):
+        """Copy current (possibly averaged over devices) params out."""
+        self.execgrp.get_params(arg_params, aux_params)
+
+    @property
+    def param_arrays(self):
+        return self.execgrp.param_arrays
+
+    @property
+    def grad_arrays(self):
+        return self.execgrp.grad_arrays
+
+    @property
+    def aux_arrays(self):
+        return self.execgrp.aux_arrays if hasattr(self.execgrp, "aux_arrays") \
+            else []
+
+    # ---- per-batch flow (reference :308-343) -----------------------------
+    def load_data_batch(self, data_batch):
+        # the actual host->device transfer happens once, inside
+        # execgrp.forward (executor_group._load_data)
+        self._cur_batch = data_batch
+
+    def forward(self, is_train=False):
+        self.execgrp.forward(self._cur_batch, is_train=is_train)
+
+    def backward(self):
+        self.execgrp.backward()
+
+    def update_metric(self, metric, labels):
+        self.execgrp.update_metric(metric, labels)

@@ -192,8 +192,75 @@ def _reduce(vals: List[NDArray]) -> NDArray:
     return NDArray(acc)
 
 
+class PSKVStore(KVStore):
+    """Parameter-server-backed dist store (kvstore_server.py): weights live
+    on the server; push/pull are RPCs — the reference KVStoreDist worker
+    (kvstore_dist.h). Selected when a PS URI is configured; the collective
+    (in-graph all-reduce) KVStore remains the default dist path."""
+
+    def __init__(self, kv_type):
+        super().__init__(kv_type)
+        from .kvstore_server import PSClient, num_workers
+
+        self._client = PSClient()
+        self._n_workers = num_workers()
+        self._rank = int(__import__("os").environ.get(
+            "MXNET_TPU_WORKER_RANK",
+            __import__("os").environ.get("DMLC_WORKER_ID", "0")))
+        if self._rank == 0:
+            # rank-0 worker announces the consistency mode, as in
+            # kvstore.cc:31-38 (kSyncMode command to servers)
+            self._client.set_sync("async" not in kv_type)
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._n_workers
+
+    def init(self, key, value):
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            self._client.init(k, v.asnumpy())
+        self.barrier()
+
+    def push(self, key, value, priority=0):
+        keys, grouped = _group_kv(key, value)
+        for k, vals in zip(keys, grouped):
+            merged = _reduce(vals)  # local device reduce before the wire
+            self._client.push(k, merged.asnumpy())
+
+    def pull(self, key, out=None, priority=0):
+        keys, grouped = _group_kv(key, out)
+        for k, outs in zip(keys, grouped):
+            val = self._client.pull(k)
+            for o in outs:
+                # preserve the target's mesh sharding (Comm::Broadcast
+                # semantics), as base KVStore.pull does
+                o._data = jax.device_put(val.astype(o.dtype),
+                                         o._data.sharding)
+
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+        if self._rank == 0:
+            self._client.set_optimizer(optimizer)
+        self.barrier()
+
+    def barrier(self):
+        self._client.barrier()
+
+    def stop_server(self):
+        if self._rank == 0:
+            self._client.stop()
+
+
 def create(name="local") -> KVStore:
-    """Factory (reference KVStore::Create, src/kvstore/kvstore.cc:17-45)."""
+    """Factory (reference KVStore::Create, src/kvstore/kvstore.cc:17-45).
+    dist types use the in-graph collective store unless a parameter server
+    is configured (MXNET_TPU_PS_URI / DMLC_PS_ROOT_URI), in which case the
+    PS worker client is returned — the reference's `dist_*` topology."""
     if not isinstance(name, str):
         raise TypeError("name must be string")
     valid = (
@@ -202,4 +269,10 @@ def create(name="local") -> KVStore:
     )
     if name not in valid:
         raise MXNetError("unknown kvstore type %r (valid: %s)" % (name, valid))
+    if "dist" in name:
+        import os
+
+        if os.environ.get("MXNET_TPU_PS_URI") or os.environ.get(
+                "DMLC_PS_ROOT_URI"):
+            return PSKVStore(name)
     return KVStore(name)

@@ -1,0 +1,280 @@
+"""Parameter-server service: the ps-lite functional equivalent.
+
+Parity with src/kvstore/kvstore_dist_server.h + python/mxnet/
+kvstore_server.py (SURVEY §2.1 #25-26, §3.4). The collective (`dist_sync`)
+path of this framework needs no servers — gradients all-reduce in-graph
+over ICI/DCN (parallel/). This module exists for the OTHER capability the
+reference's PS provides: **asynchronous** (Hogwild) and hierarchical
+updates where an optimizer step runs on merged gradients *outside* the
+training step, plus the worker/server/scheduler process topology that
+`tools/launch.py` spawns.
+
+Design (host-side, CPU — weights live on servers, as in the reference):
+
+- Transport: `multiprocessing.connection` (stdlib, pickle framing) instead
+  of ZeroMQ. One `Listener` per server; each worker holds one duplex
+  connection. `SArray` zero-copy becomes numpy buffers.
+- Server loop: connection-handler threads enqueue requests onto a single
+  dispatch queue consumed by ONE thread — the reference's single-thread
+  `Executor` run loop (kvstore_dist_server.h:28-85), which serializes all
+  state mutation (no locks on the store itself).
+- Sync mode: pushes accumulate into a per-key merge buffer; the updater
+  runs once when all `num_workers` contributions arrived, then every
+  waiting worker gets its reply — exactly DataHandle sync
+  (kvstore_dist_server.h:164-198). Async mode applies immediately
+  (:199-207).
+- `set_optimizer` ships a pickled optimizer to the server, as the
+  reference pickles through `_send_command_to_servers`
+  (python/mxnet/kvstore.py set_optimizer).
+
+Role selection mirrors the reference's import-time dispatch
+(python/mxnet/kvstore_server.py:26-67): a process with
+MXNET_TPU_ROLE/DMLC_ROLE == "server" calls `run()` and blocks until a
+worker sends stop.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import queue
+from typing import Any, Dict, Optional
+
+import numpy as np
+from multiprocessing.connection import Client, Listener
+
+from .base import MXNetError
+
+_AUTH = b"mxnet_tpu_ps"
+
+
+def _uri():
+    uri = os.environ.get("MXNET_TPU_PS_URI") or os.environ.get(
+        "DMLC_PS_ROOT_URI")
+    if uri is None:
+        return None
+    if ":" in uri:
+        host, port = uri.rsplit(":", 1)
+    else:
+        host, port = uri, os.environ.get("DMLC_PS_ROOT_PORT", "9091")
+    return (host, int(port))
+
+
+def role() -> str:
+    return os.environ.get("MXNET_TPU_ROLE",
+                          os.environ.get("DMLC_ROLE", "worker"))
+
+
+def num_workers() -> int:
+    return int(os.environ.get("MXNET_TPU_NUM_WORKERS",
+                              os.environ.get("DMLC_NUM_WORKER", "1")))
+
+
+class KVStoreServer:
+    """One server process's state + run loop."""
+
+    def __init__(self, address=None, n_workers: Optional[int] = None,
+                 sync_mode: bool = True):
+        self.address = address or _uri() or ("127.0.0.1", 9091)
+        self.n_workers = n_workers or num_workers()
+        self.sync_mode = sync_mode
+        self.store: Dict[Any, np.ndarray] = {}
+        self.updater = None
+        self._merge: Dict[Any, np.ndarray] = {}
+        self._merge_count: Dict[Any, int] = {}
+        self._waiting: Dict[Any, list] = {}
+        self._barrier_conns: list = []
+        self._q: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._ready = threading.Event()
+
+    # --- request handling (single dispatch thread) ------------------------
+    def _apply(self, key, merged):
+        if self.updater is not None:
+            if key not in self.store:
+                self.store[key] = np.zeros_like(merged)
+            self.updater(key, merged, self.store[key])
+        else:
+            self.store[key] = np.array(merged, copy=True)
+
+    def _handle(self, conn, req):
+        op = req[0]
+        if op == "init":
+            key, val = req[1], req[2]
+            if key not in self.store:  # first init wins (rank-0 semantics)
+                self.store[key] = np.array(val, copy=True)
+            conn.send(("ok",))
+        elif op == "push":
+            key, val = req[1], req[2]
+            if self.sync_mode:
+                if key in self._merge:
+                    self._merge[key] += val
+                else:
+                    self._merge[key] = np.array(val, copy=True)
+                self._merge_count[key] = self._merge_count.get(key, 0) + 1
+                self._waiting.setdefault(key, []).append(conn)
+                if self._merge_count[key] == self.n_workers:
+                    self._apply(key, self._merge.pop(key))
+                    self._merge_count[key] = 0
+                    for c in self._waiting.pop(key):
+                        c.send(("ok",))
+            else:
+                self._apply(key, val)
+                conn.send(("ok",))
+        elif op == "pull":
+            key = req[1]
+            if key not in self.store:
+                conn.send(("err", "pull of uninitialized key %r" % (key,)))
+            else:
+                conn.send(("ok", self.store[key]))
+        elif op == "set_optimizer":
+            from . import optimizer as opt
+
+            optimizer = pickle.loads(req[1])
+            self.updater = _NumpyUpdater(optimizer)
+            conn.send(("ok",))
+        elif op == "set_sync":
+            # rank-0 worker announces consistency mode (kvstore.cc:31-38
+            # kSyncMode command)
+            self.sync_mode = bool(req[1])
+            conn.send(("ok",))
+        elif op == "barrier":
+            self._barrier_conns.append(conn)
+            if len(self._barrier_conns) == self.n_workers:
+                for c in self._barrier_conns:
+                    c.send(("ok",))
+                self._barrier_conns = []
+        elif op == "stop":
+            conn.send(("ok",))
+            self._stop.set()
+        else:
+            conn.send(("err", "unknown op %r" % (op,)))
+
+    # --- threads ----------------------------------------------------------
+    def _reader(self, conn):
+        try:
+            while not self._stop.is_set():
+                req = conn.recv()
+                self._q.put((conn, req))
+        except (EOFError, OSError):
+            pass
+
+    def _accept_loop(self, listener):
+        while not self._stop.is_set():
+            try:
+                conn = listener.accept()
+            except OSError:
+                break
+            threading.Thread(target=self._reader, args=(conn,),
+                             daemon=True).start()
+
+    def run(self):
+        """Blocking server loop (reference KVStoreDistServer::Run)."""
+        listener = Listener(self.address, authkey=_AUTH)
+        self._ready.set()
+        threading.Thread(target=self._accept_loop, args=(listener,),
+                         daemon=True).start()
+        while not self._stop.is_set():
+            try:
+                conn, req = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self._handle(conn, req)
+            except (EOFError, OSError):
+                pass
+        listener.close()
+
+    def start_background(self):
+        """Run in a daemon thread (in-process servers for tests/notebooks)."""
+        t = threading.Thread(target=self.run, daemon=True)
+        t.start()
+        self._ready.wait(timeout=10)
+        return t
+
+
+class _NumpyUpdater:
+    """Server-side updater applying a framework optimizer to numpy weights
+    (the reference server runs fused optimizer ops on its engine; here the
+    server is a host process, so updates are numpy/jax-on-cpu)."""
+
+    def __init__(self, optimizer):
+        from . import ndarray as nd
+
+        self.optimizer = optimizer
+        self.states: Dict[Any, Any] = {}
+        self._nd = nd
+
+    def __call__(self, key, grad, weight):
+        nd = self._nd
+        ikey = key if isinstance(key, int) else abs(hash(key)) % (10 ** 9)
+        w = nd.array(weight)
+        g = nd.array(grad)
+        if key not in self.states:
+            self.states[key] = self.optimizer.create_state(ikey, w)
+        self.optimizer.update(ikey, w, g, self.states[key])
+        weight[...] = w.asnumpy()
+
+
+class PSClient:
+    """Worker-side connection (reference ps::KVWorker ZPush/ZPull)."""
+
+    def __init__(self, address=None):
+        self.address = address or _uri()
+        if self.address is None:
+            raise MXNetError(
+                "no parameter server configured: set MXNET_TPU_PS_URI "
+                "(host:port) or DMLC_PS_ROOT_URI/DMLC_PS_ROOT_PORT")
+        self._conn = None
+        self._lock = threading.Lock()
+
+    def _connect(self):
+        if self._conn is None:
+            self._conn = Client(self.address, authkey=_AUTH)
+        return self._conn
+
+    def _rpc(self, *req):
+        with self._lock:
+            conn = self._connect()
+            conn.send(req)
+            resp = conn.recv()
+        if resp[0] != "ok":
+            raise MXNetError("ps error: %s" % (resp[1],))
+        return resp[1] if len(resp) > 1 else None
+
+    def init(self, key, value: np.ndarray):
+        self._rpc("init", key, np.asarray(value))
+
+    def push(self, key, value: np.ndarray):
+        self._rpc("push", key, np.asarray(value))
+
+    def pull(self, key) -> np.ndarray:
+        return self._rpc("pull", key)
+
+    def set_optimizer(self, optimizer):
+        self._rpc("set_optimizer", pickle.dumps(optimizer))
+
+    def set_sync(self, sync: bool):
+        self._rpc("set_sync", sync)
+
+    def barrier(self):
+        self._rpc("barrier")
+
+    def stop(self):
+        self._rpc("stop")
+
+
+def run():
+    """Entry for server-role processes: block until stopped (reference
+    python/mxnet/kvstore_server.py:26-67 _init_kvstore_server_module)."""
+    server = KVStoreServer()
+    server.run()
+
+
+def maybe_run_server_by_role():
+    """Auto-start when launched with a server role, as the reference does
+    at import (kvstore_server.py module bottom)."""
+    if role() == "server":
+        run()
+        return True
+    return False

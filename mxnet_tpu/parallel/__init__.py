@@ -10,10 +10,11 @@ group2ctx model parallel     NamedSharding / shard_map placement (mesh.py)
 (absent in reference) TP     tensor_parallel.py sharding rules
 (absent) SP / long context   ring_attention.py (ppermute ring over "seq")
 (absent) PP micro-batching   pipeline.py (SPMD shift-register pipeline)
+(absent) EP / MoE            moe.py (Switch routing + all_to_all dispatch)
 tools/bandwidth harness      collectives.bus_bandwidth
 ==========================  =================================================
 
-Mesh axes are canonically named ("data", "seq", "pipe", "model").
+Mesh axes are canonically named ("data", "expert", "seq", "pipe", "model").
 """
 from .mesh import MeshConfig, auto_mesh, make_mesh, AXES
 from . import collectives
@@ -22,5 +23,6 @@ from .collectives import (all_reduce, all_gather, reduce_scatter, ring_shift,
 from . import tensor_parallel
 from . import ring_attention
 from . import pipeline
+from . import moe
 from . import transformer
 from . import dist

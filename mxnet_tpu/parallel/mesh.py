@@ -14,24 +14,29 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("data", "seq", "pipe", "model")
+AXES = ("data", "expert", "seq", "pipe", "model")
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
-    """Axis sizes for the canonical 4-axis mesh. Any axis may be 1."""
+    """Axis sizes for the canonical 5-axis mesh. Any axis may be 1.
+
+    "expert" is a dedicated expert-parallel axis (parallel/moe.py); MoE
+    experts are sharded over the combined (data, expert, seq) group, so EP
+    is exercised even when the expert axis itself is size 1."""
 
     data: int = 1
+    expert: int = 1
     seq: int = 1
     pipe: int = 1
     model: int = 1
 
     @property
     def size(self) -> int:
-        return self.data * self.seq * self.pipe * self.model
+        return self.data * self.expert * self.seq * self.pipe * self.model
 
     def axis_sizes(self):
-        return (self.data, self.seq, self.pipe, self.model)
+        return (self.data, self.expert, self.seq, self.pipe, self.model)
 
 
 def make_mesh(config: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
@@ -50,16 +55,18 @@ def make_mesh(config: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
 
 
 def auto_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
-    """Factor n devices into (data, seq, pipe, model) greedily: split off
-    2s into model, then pipe, then seq, rest to data. Guarantees every
-    axis code path is exercised on n>=8 (the virtual-CPU test mesh)."""
+    """Factor n devices into (data, expert, seq, pipe, model) greedily:
+    split off 2s into model, then pipe, then seq, then expert, rest to
+    data. Guarantees tp/pp/sp are exercised on n>=8 (the virtual-CPU test
+    mesh) and the dedicated expert axis on n>=16; EP itself is exercised
+    for any n>=2 because experts shard over (data, expert, seq)."""
     if devices is None:
         devices = jax.devices()
     if n_devices is not None:
         devices = devices[:n_devices]
     n = len(devices)
-    sizes = {"data": 1, "seq": 1, "pipe": 1, "model": 1}
-    for axis in ("model", "pipe", "seq"):
+    sizes = {"data": 1, "expert": 1, "seq": 1, "pipe": 1, "model": 1}
+    for axis in ("model", "pipe", "seq", "expert"):
         if n % 2 == 0 and n > 1:
             sizes[axis] *= 2
             n //= 2

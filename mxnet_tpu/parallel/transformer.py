@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
+from .moe import EXPERT_GROUP, scale_expert_grads, switch_moe_local
 from .pipeline import spmd_pipeline_local
 from .ring_attention import _ring_attn_local
 
@@ -41,46 +42,75 @@ class TransformerConfig:
     layers_per_stage: int = 1
     seq_len: int = 32
     dtype: Any = jnp.float32
+    # MoE / expert parallelism (parallel/moe.py). When moe=True the dense
+    # FFN of every layer becomes a Switch-routed expert FFN whose experts
+    # are sharded over the (data, expert, seq) group — "ep" in the dryrun.
+    moe: bool = False
+    n_experts_local: int = 2
+    capacity_factor: float = 2.0
 
 
-def init_params(cfg: TransformerConfig, n_stages: int, key=None):
+# Parameters carrying a leading pipeline-stage axis (sharded over "pipe").
+_STAGE_KEYS = ("wqkv", "wo", "w1", "w2", "ln1", "ln2", "wg", "w1e", "w2e")
+# Expert-sharded parameters: grads are 1/G-scaled, not pmean'd (moe.py).
+EXPERT_KEYS = ("w1e", "w2e")
+
+
+def init_params(cfg: TransformerConfig, n_stages: int, key=None,
+                expert_group: int = 1):
     """Stacked parameters: layer weights carry leading axes
-    (n_stages, layers_per_stage, ...) — "pipe" shards axis 0."""
+    (n_stages, layers_per_stage, ...) — "pipe" shards axis 0. For MoE,
+    expert_group = data*expert*seq mesh size; the global expert count is
+    expert_group * cfg.n_experts_local."""
     if key is None:
         key = jax.random.PRNGKey(0)
-    ks = jax.random.split(key, 8)
+    ks = jax.random.split(key, 10)
     d, f, v = cfg.dm, cfg.dff, cfg.vocab
     L = (n_stages, cfg.layers_per_stage)
 
     def nrm(k, shape, scale):
         return (jax.random.normal(k, shape) * scale).astype(cfg.dtype)
 
-    return {
+    params = {
         "embed": nrm(ks[0], (v, d), 0.02),
         "wqkv": nrm(ks[1], L + (d, 3 * d), d ** -0.5),
         "wo": nrm(ks[2], L + (d, d), d ** -0.5),
-        "w1": nrm(ks[3], L + (d, f), d ** -0.5),
-        "w2": nrm(ks[4], L + (f, d), f ** -0.5),
         "ln1": jnp.ones(L + (d,), cfg.dtype),
         "ln2": jnp.ones(L + (d,), cfg.dtype),
         "lnf": jnp.ones((d,), cfg.dtype),
         "unembed": nrm(ks[5], (d, v), d ** -0.5),
     }
+    if cfg.moe:
+        n_exp = expert_group * cfg.n_experts_local
+        params["wg"] = nrm(ks[6], L + (d, n_exp), d ** -0.5)
+        params["w1e"] = nrm(ks[7], L + (n_exp, d, f), d ** -0.5)
+        params["w2e"] = nrm(ks[8], L + (n_exp, f, d), f ** -0.5)
+    else:
+        params["w1"] = nrm(ks[3], L + (d, f), d ** -0.5)
+        params["w2"] = nrm(ks[4], L + (f, d), f ** -0.5)
+    return params
 
 
 def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
-    """Mesh shardings: "pipe" on the stage axis, "model" on the TP dim."""
-    return {
+    """Mesh shardings: "pipe" on the stage axis, "model" on the TP dim,
+    the (data, expert, seq) group on the MoE expert axis."""
+    specs = {
         "embed": P(None, "model"),
         "wqkv": P("pipe", None, None, "model"),
         "wo": P("pipe", None, "model", None),
-        "w1": P("pipe", None, None, "model"),
-        "w2": P("pipe", None, "model", None),
         "ln1": P("pipe", None, None),
         "ln2": P("pipe", None, None),
         "lnf": P(None),
         "unembed": P(None, "model"),
     }
+    if cfg.moe:
+        specs["wg"] = P("pipe", None, None, None)
+        specs["w1e"] = P("pipe", None, EXPERT_GROUP, None, "model")
+        specs["w2e"] = P("pipe", None, EXPERT_GROUP, "model", None)
+    else:
+        specs["w1"] = P("pipe", None, None, "model")
+        specs["w2"] = P("pipe", None, "model", None)
+    return specs
 
 
 def _ln(x, g):
@@ -108,9 +138,19 @@ def _layer(p, x, cfg: TransformerConfig, li):
     o = jax.lax.psum(o, "model")
     x = x + o
     h = _ln(x, p["ln2"][li])
-    h = jax.nn.gelu(h @ p["w1"][li])
-    h = h @ p["w2"][li]
-    h = jax.lax.psum(h, "model")
+    if cfg.moe:
+        bb, tt, dd = h.shape
+        # Switch-MoE over the (data, expert, seq) expert group; the router
+        # aux loss is dropped here (capacity bounds enforce balance) —
+        # standalone users get it from switch_moe_local directly.
+        y, _aux = switch_moe_local(
+            h.reshape(bb * tt, dd), p["wg"][li], p["w1e"][li], p["w2e"][li],
+            capacity_factor=cfg.capacity_factor)
+        h = y.reshape(bb, tt, dd)
+    else:
+        h = jax.nn.gelu(h @ p["w1"][li])
+        h = h @ p["w2"][li]
+        h = jax.lax.psum(h, "model")
     return x + h
 
 
@@ -146,8 +186,8 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, n_micro: int = None,
             sp = jax.tree_util.tree_map(lambda a: a[0], sp_params)
             return _stage_fn(sp, h, cfg)
 
-        stage_params = {k2: params[k2] for k2 in
-                        ("wqkv", "wo", "w1", "w2", "ln1", "ln2")}
+        stage_params = {k2: params[k2] for k2 in _STAGE_KEYS
+                        if k2 in params}
         out = spmd_pipeline_local(stage, stage_params, x_mb, axis="pipe")
         out = out.reshape((b,) + out.shape[2:])
         out = _ln(out, params["lnf"])
@@ -171,22 +211,26 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, n_micro: int = None,
         # LOCAL mean; the cross-(data,seq) mean happens on the gradients
         return jnp.mean(nll)
 
-    in_specs = (specs, P("data", "seq"), P("data", "seq"))
+    batch_spec = P(("data", "expert"), "seq")
+    in_specs = (specs, batch_spec, batch_spec)
+    dp_axes = ("data", "expert", "seq")
 
     def step(params, tokens, targets):
         loss, grads = jax.value_and_grad(
             lambda p: local_fwd(p, tokens, targets))(params)
         # DP/SP gradient all-reduce — the in-graph kvstore push/pull
-        # (SURVEY §5.8: CommDevice reduce ≡ psum over ICI)
-        grads = jax.tree_util.tree_map(
-            lambda g: jax.lax.pmean(g, ("data", "seq")), grads)
+        # (SURVEY §5.8: CommDevice reduce ≡ psum over ICI). Expert-sharded
+        # weights hold DIFFERENT experts per rank: AD already summed the
+        # cross-rank contributions through the all_to_all transpose, so
+        # they take a 1/G scale instead of a pmean (moe.scale_expert_grads).
+        grads = scale_expert_grads(grads, EXPERT_KEYS, group=dp_axes)
         # embed's cotangent only reaches pipe rank 0 (the pipeline ingests
         # x there); psum makes it whole. unembed/lnf grads are computed
         # identically on every pipe rank (post-broadcast graph) — no-op.
         grads["embed"] = jax.lax.psum(grads["embed"], "pipe")
         new_params = jax.tree_util.tree_map(
             lambda p, g: (p - lr * g).astype(p.dtype), params, grads)
-        loss = jax.lax.pmean(loss, ("data", "seq"))
+        loss = jax.lax.pmean(loss, dp_axes)
         return loss, new_params
 
     smapped = shard_map(

@@ -1,0 +1,244 @@
+"""Run PyTorch modules / criteria / functions as framework ops.
+
+Capability parity with the reference's Torch plugin (python/mxnet/torch.py
+Torch function+criterion wrappers, and plugin/torch/torch_module.cc's
+TorchModule op — SURVEY §2.4, §2.5). The reference embeds a Lua Torch7
+interpreter behind a native op; here the foreign-kernel seam is the Custom
+op bridge (operator.py → jax.pure_callback), so a `torch.nn.Module`
+executes on host inside an otherwise jit-compiled graph, with backward
+supplied by torch autograd.
+
+    import mxnet_tpu as mx
+    import torch as th
+
+    op = mx.torch.module_op(th.nn.Conv2d(3, 8, 3, padding=1), "th_conv")
+    y = mx.nd.Custom(x, op_type=op)            # imperative
+    s = mx.sym.Custom(data=d, op_type=op)      # symbolic
+
+Everything is gated on torch being importable; the module degrades to a
+clear error otherwise (the reference's plugin is likewise opt-in via
+TORCH_PATH, make/config.mk).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .base import MXNetError
+from . import operator as _operator
+from . import ndarray as nd
+
+try:  # torch (CPU build) is an optional host-side dependency
+    import torch as _th
+except ImportError:  # pragma: no cover
+    _th = None
+
+
+def _require_torch():
+    if _th is None:  # pragma: no cover
+        raise MXNetError(
+            "mxnet_tpu.torch requires PyTorch; install torch (CPU is "
+            "sufficient — it only runs host-side kernels)")
+    return _th
+
+
+def _to_torch(a: np.ndarray, requires_grad: bool):
+    t = _th.from_numpy(np.ascontiguousarray(a))
+    if requires_grad and t.is_floating_point():
+        t = t.clone().requires_grad_(True)
+    return t
+
+
+class _TorchModuleOp(_operator.CustomOp):
+    """CustomOp executing a torch.nn.Module; backward via torch autograd."""
+
+    def __init__(self, module):
+        self.module = module
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        xs = [_to_torch(np.asarray(x), False) for x in in_data]
+        with _th.no_grad():
+            out = self.module(*xs)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        for i, (dst, src) in enumerate(zip(out_data, outs)):
+            self.assign(dst, req[i] if isinstance(req, (list, tuple)) else req,
+                        src.detach().numpy())
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        xs = [_to_torch(np.asarray(x), True) for x in in_data]
+        params = [p for p in self.module.parameters() if p.requires_grad]
+        out = self.module(*xs)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        gs = [_th.from_numpy(np.ascontiguousarray(np.asarray(g)))
+              for g in out_grad[:len(outs)]]
+        _th.autograd.backward(list(outs), gs)
+        for i, (dst, x) in enumerate(zip(in_grad, xs)):
+            g = x.grad
+            r = req[i] if isinstance(req, (list, tuple)) else req
+            self.assign(dst, r,
+                        g.numpy() if g is not None
+                        else np.zeros_like(np.asarray(in_data[i])))
+        # torch-side parameters train in place with torch's own grads; an
+        # explicit torch optimizer step is the user's choice (the reference
+        # likewise leaves Torch module weights to Torch, torch_module.cc)
+
+
+class _TorchFunctionOp(_operator.CustomOp):
+    """CustomOp for a pure torch function (autograd.grad for backward)."""
+
+    def __init__(self, fn, num_outputs):
+        self.fn = fn
+        self.num_outputs = num_outputs
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        xs = [_to_torch(np.asarray(x), False) for x in in_data]
+        with _th.no_grad():
+            out = self.fn(*xs)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        for i, (dst, src) in enumerate(zip(out_data, outs)):
+            r = req[i] if isinstance(req, (list, tuple)) else req
+            self.assign(dst, r, src.detach().numpy())
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        xs = [_to_torch(np.asarray(x), True) for x in in_data]
+        out = self.fn(*xs)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        gs = [_th.from_numpy(np.ascontiguousarray(np.asarray(g)))
+              for g in out_grad[:len(outs)]]
+        diff = [x for x in xs if x.requires_grad]
+        grads = (_th.autograd.grad(list(outs), diff, gs, allow_unused=True)
+                 if diff else ())
+        it = iter(grads)
+        for i, (dst, x) in enumerate(zip(in_grad, xs)):
+            r = req[i] if isinstance(req, (list, tuple)) else req
+            if x.requires_grad:
+                g = next(it)
+                self.assign(dst, r,
+                            g.numpy() if g is not None
+                            else np.zeros_like(np.asarray(in_data[i])))
+            else:
+                self.assign(dst, r, np.zeros_like(np.asarray(in_data[i])))
+
+
+def _infer_by_tracing(module_or_fn, in_shape, num_outputs):
+    th = _require_torch()
+    xs = [th.zeros(tuple(s)) for s in in_shape]
+    with th.no_grad():
+        out = module_or_fn(*xs)
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    return [list(o.shape) for o in outs[:num_outputs]]
+
+
+def module_op(module, name: str, n_inputs: int = 1,
+              num_outputs: int = 1) -> str:
+    """Register `module` (a torch.nn.Module) as Custom op type `name`.
+    Returns the op_type string for nd/sym.Custom. Output shapes are
+    inferred by tracing the module on zeros (the reference's TorchModule
+    declares them manually)."""
+    _require_torch()
+    mod = module
+
+    @_operator.register(name)
+    class _Prop(_operator.CustomOpProp):  # noqa: N801
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def list_arguments(self):
+            return ["data%d" % i for i in range(n_inputs)]
+
+        def list_outputs(self):
+            return (["output"] if num_outputs == 1 else
+                    ["output%d" % i for i in range(num_outputs)])
+
+        def infer_shape(self, in_shape):
+            out = _infer_by_tracing(mod, in_shape, num_outputs)
+            return in_shape, out, []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return _TorchModuleOp(mod)
+
+    return name
+
+
+def function_op(fn: Callable, name: str, n_inputs: int = 1,
+                num_outputs: int = 1) -> str:
+    """Register a pure torch function (e.g. `torch.special.logit`, or any
+    composition) as Custom op type `name` — the reference's torch function
+    wrappers (python/mxnet/torch.py)."""
+    _require_torch()
+
+    @_operator.register(name)
+    class _Prop(_operator.CustomOpProp):  # noqa: N801
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def list_arguments(self):
+            return ["data%d" % i for i in range(n_inputs)]
+
+        def list_outputs(self):
+            return (["output"] if num_outputs == 1 else
+                    ["output%d" % i for i in range(num_outputs)])
+
+        def infer_shape(self, in_shape):
+            out = _infer_by_tracing(fn, in_shape, num_outputs)
+            return in_shape, out, []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return _TorchFunctionOp(fn, num_outputs)
+
+    return name
+
+
+def criterion_op(criterion, name: str) -> str:
+    """Register a torch criterion (loss(input, target) -> scalar) as a
+    2-input Custom op (the reference's TorchCriterion wrappers)."""
+    _require_torch()
+
+    def fn(x, t):
+        return criterion(x, t)
+
+    @_operator.register(name)
+    class _Prop(_operator.CustomOpProp):  # noqa: N801
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+
+        def list_arguments(self):
+            return ["data", "label"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [[1]], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return _TorchCriterionOp(criterion)
+
+    return name
+
+
+class _TorchCriterionOp(_operator.CustomOp):
+    def __init__(self, criterion):
+        self.criterion = criterion
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = _to_torch(np.asarray(in_data[0]), False)
+        t = _to_torch(np.asarray(in_data[1]), False)
+        with _th.no_grad():
+            loss = self.criterion(x, t)
+        self.assign(out_data[0],
+                    req[0] if isinstance(req, (list, tuple)) else req,
+                    np.asarray([float(loss)], np.float32))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        x = _to_torch(np.asarray(in_data[0]), True)
+        t = _to_torch(np.asarray(in_data[1]), False)
+        loss = self.criterion(x, t)
+        loss.backward()
+        r0 = req[0] if isinstance(req, (list, tuple)) else req
+        self.assign(in_grad[0], r0, x.grad.numpy())
+        if len(in_grad) > 1:
+            r1 = req[1] if isinstance(req, (list, tuple)) else req
+            self.assign(in_grad[1], r1,
+                        np.zeros_like(np.asarray(in_data[1])))

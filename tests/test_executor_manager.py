@@ -1,0 +1,65 @@
+"""Legacy executor-manager layer tests (reference executor_manager.py via
+FeedForward; SURVEY §2.4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.executor_manager import (DataParallelExecutorManager,
+                                        _split_input_slice)
+
+
+def test_split_input_slice_weighted():
+    sl = _split_input_slice(10, [1, 1])
+    assert sl == [slice(0, 5), slice(5, 10)]
+    sl = _split_input_slice(10, [3, 1, 1])
+    assert sl[0] == slice(0, 6)
+    assert sum(s.stop - s.start for s in sl) == 10
+    with pytest.raises(mx.MXNetError):
+        _split_input_slice(2, [1, 1, 1, 1])  # a device would get 0 rows
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(data=fc, act_type="relu")
+    fc2 = mx.sym.FullyConnected(data=act, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def test_executor_manager_forward_backward():
+    batch, dim = 8, 6
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, dim).astype(np.float32)
+    y = rng.randint(0, 4, (32,)).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=batch, label_name="softmax_label")
+
+    sym = _mlp()
+    mgr = DataParallelExecutorManager(sym, [mx.cpu(0), mx.cpu(1)], it)
+    assert len(mgr.slices) == 2
+
+    arg_params = {}
+    init = mx.initializer.Uniform(0.1)
+    for name in mgr.param_names:
+        shapes, _, _ = sym.infer_shape(data=(batch, dim))
+        shape = dict(zip(sym.list_arguments(), shapes))[name]
+        arr = mx.nd.zeros(shape)
+        init(mx.initializer.InitDesc(name), arr)
+        arg_params[name] = arr
+    mgr.set_params(arg_params, {})
+
+    it.reset()
+    batch_data = next(it)
+    mgr.load_data_batch(batch_data)
+    mgr.forward(is_train=True)
+    mgr.backward()
+
+    metric = mx.metric.create("acc")
+    mgr.update_metric(metric, batch_data.label)
+    name, val = metric.get()
+    assert 0.0 <= val <= 1.0
+
+    out_arg, out_aux = {}, {}
+    mgr.copy_to(out_arg, out_aux)
+    assert set(out_arg) == set(mgr.param_names)
+    for g in mgr.grad_arrays:
+        assert g is not None

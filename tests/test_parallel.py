@@ -14,9 +14,11 @@ from mxnet_tpu.ops.attention import dot_product_attention
 
 def test_auto_mesh_factorization():
     mesh = auto_mesh(8)
-    assert dict(mesh.shape) == {"data": 1, "seq": 2, "pipe": 2, "model": 2}
+    assert dict(mesh.shape) == {"data": 1, "expert": 1, "seq": 2,
+                                "pipe": 2, "model": 2}
     mesh = auto_mesh(4)
-    assert dict(mesh.shape) == {"data": 1, "seq": 1, "pipe": 2, "model": 2}
+    assert dict(mesh.shape) == {"data": 1, "expert": 1, "seq": 1,
+                                "pipe": 2, "model": 2}
 
 
 def test_mesh_all_reduce_and_bandwidth():
@@ -82,6 +84,83 @@ def test_sharded_transformer_step_runs_and_matches_single_device():
     np.testing.assert_allclose(float(loss1), ref_loss, rtol=1e-4)
 
 
+def test_switch_moe_local_matches_dense_routing():
+    """Expert-parallel Switch FFN over a 2-wide (data,expert,seq) group
+    == per-token top-1 expert FFN when capacity is ample (no drops)."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    from mxnet_tpu.parallel import moe
+
+    mesh = make_mesh(MeshConfig(data=2, seq=2, pipe=1, model=2))
+    g = 4                      # data*expert*seq group size
+    e_local, d, f = 2, 8, 16
+    n_exp = g * e_local
+    t_tot = 32
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(t_tot, d), jnp.float32)
+    wg = jnp.asarray(rng.randn(d, n_exp) * 0.5, jnp.float32)
+    w1 = jnp.asarray(rng.randn(n_exp, d, f) * 0.3, jnp.float32)
+    w2 = jnp.asarray(rng.randn(n_exp, f, d) * 0.3, jnp.float32)
+
+    def body(x, wg, w1, w2):
+        y, aux = moe.switch_moe_local(x, wg, w1, w2,
+                                      capacity_factor=float(n_exp))
+        return y, aux
+
+    f_sh = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(moe.EXPERT_GROUP), P(), P(moe.EXPERT_GROUP, None, "model"),
+                  P(moe.EXPERT_GROUP, "model", None)),
+        out_specs=(P(moe.EXPERT_GROUP), P()), check_vma=False)
+    y, aux = jax.jit(f_sh)(x, wg, w1, w2)
+    assert np.isfinite(float(aux))
+
+    probs = jax.nn.softmax(x @ wg, axis=-1)
+    eidx = jnp.argmax(probs, axis=-1)
+    gate = jnp.max(probs, axis=-1)
+    ref = gate[:, None] * jnp.einsum(
+        "tf,tfd->td", jax.nn.gelu(jnp.einsum("td,tdf->tf", x, w1[eidx])),
+        w2[eidx])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_moe_transformer_step_matches_reference_and_trains():
+    mesh = make_mesh(MeshConfig(data=1, seq=2, pipe=2, model=2))
+    expert_group = mesh.shape["data"] * mesh.shape["expert"] * mesh.shape["seq"]
+    cfg = transformer.TransformerConfig(
+        vocab=32, dm=16, heads=4, dff=32, layers_per_stage=1, seq_len=8,
+        moe=True, n_experts_local=2,
+        capacity_factor=float(expert_group * 2))   # ample: no token drops
+    params = transformer.init_params(cfg, mesh.shape["pipe"],
+                                     expert_group=expert_group)
+    sharded = transformer.shard_params(params, mesh, cfg)
+    step = transformer.make_train_step(mesh, cfg, n_micro=2, lr=0.1)
+
+    rng = np.random.RandomState(4)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab, (4, cfg.seq_len)))
+    targets = jnp.asarray(rng.randint(0, cfg.vocab, (4, cfg.seq_len)))
+    loss1, p1 = step(sharded, tokens, targets)
+    loss2, _ = step(p1, tokens, targets)
+    assert float(loss2) < float(loss1)
+
+    ref_loss = _reference_loss(params, tokens, targets, cfg,
+                               mesh.shape["pipe"])
+    np.testing.assert_allclose(float(loss1), ref_loss, rtol=1e-4)
+
+
+def _moe_ffn_reference(h, wg, w1e, w2e):
+    b, t, d = h.shape
+    x = h.reshape(b * t, d)
+    probs = jax.nn.softmax(x @ wg, axis=-1)
+    eidx = jnp.argmax(probs, axis=-1)
+    gate = jnp.max(probs, axis=-1)
+    y = gate[:, None] * jnp.einsum(
+        "tf,tfd->td", jax.nn.gelu(jnp.einsum("td,tdf->tf", x, w1e[eidx])),
+        w2e[eidx])
+    return y.reshape(b, t, d)
+
+
 def _reference_loss(params, tokens, targets, cfg, n_stages):
     x = jnp.take(params["embed"], tokens, axis=0)
     dh = cfg.dm // cfg.heads
@@ -95,7 +174,13 @@ def _reference_loss(params, tokens, targets, cfg, n_stages):
             att = att.transpose(0, 2, 1, 3).reshape(b, t, cfg.dm)
             x = x + att @ params["wo"][s, li]
             h = transformer._ln(x, params["ln2"][s, li])
-            x = x + jax.nn.gelu(h @ params["w1"][s, li]) @ params["w2"][s, li]
+            if cfg.moe:
+                x = x + _moe_ffn_reference(h, params["wg"][s, li],
+                                           params["w1e"][s, li],
+                                           params["w2e"][s, li])
+            else:
+                x = x + (jax.nn.gelu(h @ params["w1"][s, li])
+                         @ params["w2"][s, li])
     x = transformer._ln(x, params["lnf"])
     logits = x @ params["unembed"]
     logp = jax.nn.log_softmax(logits, axis=-1)

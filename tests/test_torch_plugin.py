@@ -1,0 +1,45 @@
+"""Torch plugin tests — foreign-kernel-as-op seam (reference plugin/torch
++ python/mxnet/torch.py; SURVEY §2.4, §2.5)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+th = pytest.importorskip("torch")
+
+
+def test_torch_function_forward_and_grad():
+    mx.torch.function_op(lambda x: th.tanh(x) * 2.0, "th_tanh2")
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    out = nd.Custom(nd.array(x), op_type="th_tanh2").asnumpy()
+    np.testing.assert_allclose(out, np.tanh(x) * 2.0, rtol=1e-5)
+
+    xa = nd.array(x)
+    xa.attach_grad()
+    with mx.autograd.record():
+        y = nd.Custom(xa, op_type="th_tanh2")
+    y.backward(nd.ones(y.shape))
+    expect = 2.0 * (1 - np.tanh(x) ** 2)
+    np.testing.assert_allclose(xa.grad.asnumpy(), expect, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_torch_module_linear():
+    lin = th.nn.Linear(5, 3)
+    mx.torch.module_op(lin, "th_lin")
+    x = np.random.RandomState(1).randn(4, 5).astype(np.float32)
+    out = nd.Custom(nd.array(x), op_type="th_lin").asnumpy()
+    with th.no_grad():
+        ref = lin(th.from_numpy(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_torch_criterion():
+    crit = th.nn.MSELoss()
+    mx.torch.criterion_op(crit, "th_mse")
+    rng = np.random.RandomState(2)
+    x = rng.randn(6).astype(np.float32)
+    t = rng.randn(6).astype(np.float32)
+    out = nd.Custom(nd.array(x), nd.array(t), op_type="th_mse").asnumpy()
+    np.testing.assert_allclose(out, [np.mean((x - t) ** 2)], rtol=1e-5)
