@@ -44,6 +44,12 @@ from . import predict
 from . import engine
 from . import rnn
 from . import profiler
+from . import image
+from . import registry
+from . import log
+from . import libinfo
+from . import contrib
+from . import notebook
 
 
 def __getattr__(name):

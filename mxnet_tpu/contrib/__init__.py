@@ -1,0 +1,13 @@
+"""Experimental contrib namespace.
+
+Capability parity with python/mxnet/contrib/ (reference): ``autograd``
+(experimental imperative-gradient API), ``ndarray``/``symbol`` (contrib op
+namespaces — CTC, fft, multibox, proposal, quantization), ``tensorboard``
+(metric-logging callback, gated on an available writer).
+"""
+from . import autograd
+from . import ndarray
+from . import ndarray as nd
+from . import symbol
+from . import symbol as sym
+from . import tensorboard

@@ -1,0 +1,101 @@
+"""Experimental imperative autograd API.
+
+Capability parity with python/mxnet/contrib/autograd.py (reference
+:14-205): the pre-gluon experimental surface — ``set_is_training``,
+``train_section``/``test_section`` scopes, ``mark_variables``,
+``compute_gradient``, and the ``grad_and_loss``/``grad`` decorators —
+implemented over the core tape in :mod:`mxnet_tpu.autograd`.
+"""
+from __future__ import annotations
+
+import functools
+
+from .. import autograd as _ag
+from ..ndarray import NDArray
+
+
+def set_is_training(is_train):
+    """Set the global training-mode flag, returning the previous value
+    (reference contrib/autograd.py:14-33). Also toggles recording, as the
+    reference's single flag did both."""
+    prev_t = _ag.set_training(bool(is_train))
+    prev_r = _ag.set_recording(bool(is_train))
+    return prev_t and prev_r
+
+
+class TrainingStateScope(object):
+    """Scope manager for switching training state
+    (reference contrib/autograd.py:34-53)."""
+
+    def __init__(self, enter_state):
+        self._enter_state = enter_state
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_is_training(self._enter_state)
+
+    def __exit__(self, ptype, value, trace):
+        if self._prev != self._enter_state:
+            set_is_training(self._prev)
+
+
+def train_section():
+    """Scope for code that computes gradients
+    (reference contrib/autograd.py:54-67)."""
+    return TrainingStateScope(True)
+
+
+def test_section():
+    """Scope for inference inside a train_section
+    (reference contrib/autograd.py:68-81)."""
+    return TrainingStateScope(False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to variables
+    (reference contrib/autograd.py:82-106)."""
+    _ag.mark_variables(variables, gradients, grad_reqs)
+
+
+def compute_gradient(outputs):
+    """Backprop from outputs; gradients land in the buffers attached by
+    :func:`mark_variables` (reference contrib/autograd.py:107-126)."""
+    _ag.backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Decorator: return a function computing both gradient of ``func``'s
+    output w.r.t. its arguments and the output itself
+    (reference contrib/autograd.py:127-158)."""
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        assert all(isinstance(x, NDArray) for x in args), (
+            "type of autograd input should be NDArray.")
+        if argnum is not None:
+            argnums = argnum if isinstance(argnum, (list, tuple)) else [argnum]
+        else:
+            argnums = list(range(len(args)))
+        variables = [args[i] for i in argnums]
+        from .. import ndarray as _nd
+        grads = [_nd.zeros_like(x) for x in variables]
+        mark_variables(variables, grads)
+        with train_section():
+            outputs = func(*args)
+        compute_gradient([outputs] if isinstance(outputs, NDArray)
+                         else list(outputs))
+        return grads, outputs
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Decorator: return a function computing only the gradient
+    (reference contrib/autograd.py:159-205)."""
+    grad_with_loss_func = grad_and_loss(func, argnum)
+
+    @functools.wraps(grad_with_loss_func)
+    def wrapped(*args):
+        return grad_with_loss_func(*args)[0]
+
+    return wrapped

@@ -1,0 +1,24 @@
+"""Contrib op namespace over NDArray.
+
+Capability parity with python/mxnet/contrib/ndarray.py: exposes the
+experimental op set (CTC loss, FFT, SSD multibox, RCNN proposal,
+quantization, count_sketch — reference src/operator/contrib/, SURVEY §2.1
+item 19) under ``mx.contrib.nd.*``, delegating to the flat generated op
+functions on :mod:`mxnet_tpu.ndarray`.
+"""
+from .. import ndarray as _nd
+
+_CONTRIB_OPS = [
+    "ctc_loss", "fft", "ifft", "quantize", "dequantize", "count_sketch",
+    "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection", "Proposal",
+]
+
+for _name in _CONTRIB_OPS:
+    if hasattr(_nd, _name):
+        globals()[_name] = getattr(_nd, _name)
+
+# Reference aliases the loss as CTCLoss in the contrib namespace.
+if hasattr(_nd, "ctc_loss"):
+    CTCLoss = _nd.ctc_loss
+
+__all__ = [n for n in _CONTRIB_OPS if n in globals()] + ["CTCLoss"]

@@ -1,0 +1,22 @@
+"""Contrib op namespace over Symbol.
+
+Capability parity with python/mxnet/contrib/symbol.py: the same
+experimental op set as :mod:`mxnet_tpu.contrib.ndarray` but building
+symbolic graph nodes, delegating to the generated op functions on
+:mod:`mxnet_tpu.symbol`.
+"""
+from .. import symbol as _sym
+
+_CONTRIB_OPS = [
+    "ctc_loss", "fft", "ifft", "quantize", "dequantize", "count_sketch",
+    "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection", "Proposal",
+]
+
+for _name in _CONTRIB_OPS:
+    if hasattr(_sym, _name):
+        globals()[_name] = getattr(_sym, _name)
+
+if hasattr(_sym, "ctc_loss"):
+    CTCLoss = _sym.ctc_loss
+
+__all__ = [n for n in _CONTRIB_OPS if n in globals()] + ["CTCLoss"]

@@ -1,0 +1,38 @@
+"""TensorBoard metric-logging callback.
+
+Capability parity with python/mxnet/contrib/tensorboard.py (reference
+:8-56): a batch-end callback that writes eval-metric scalars to an event
+log. Writer backends are optional; we try ``torch.utils.tensorboard``
+(baked into this image) and degrade to an in-memory record so the
+callback stays usable without any writer installed.
+"""
+from __future__ import annotations
+
+
+class LogMetricsCallback(object):
+    """Log metrics periodically in TensorBoard
+    (reference contrib/tensorboard.py:8-56).
+
+    Usage: ``mod.fit(..., batch_end_callback=LogMetricsCallback(dir))``.
+    """
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.history = []  # (name, value) record kept even without a writer
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            self.summary_writer = SummaryWriter(logging_dir)
+        except Exception:
+            self.summary_writer = None
+
+    def __call__(self, param):
+        """Batch-end callback: dump the metric's name/value pairs."""
+        if param.eval_metric is None:
+            return
+        name_value = param.eval_metric.get_name_value()
+        for name, value in name_value:
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.history.append((name, value))
+            if self.summary_writer is not None:
+                self.summary_writer.add_scalar(name, value)

@@ -1,0 +1,45 @@
+"""Locate native shared libraries and report the package version.
+
+Capability parity with python/mxnet/libinfo.py (reference :1-47): the
+reference's ``find_lib_path`` hunts for ``libmxnet.so``; ours locates the
+TPU-native runtime libraries built from ``native/`` (``libmxtpu_engine.so``,
+``libmxtpu_io.so``) used by the host-side dependency engine and the C++
+data plane. ``MXNET_LIBRARY_PATH``-style override via ``MXTPU_LIBRARY_PATH``.
+"""
+from __future__ import annotations
+
+import os
+
+from .base import __version__  # single source of truth (base.py)
+
+_LIB_NAMES = ("libmxtpu_engine.so", "libmxtpu_io.so")
+
+
+def find_lib_path():
+    """Return the paths of the native runtime libraries that exist.
+
+    Search order: ``MXTPU_LIBRARY_PATH`` env dir, the in-tree ``native/``
+    directories (package-local and repo-root), then system default.
+    Raises RuntimeError if none found — mirrors reference libinfo.py:13-40.
+    """
+    curr = os.path.dirname(os.path.abspath(os.path.expanduser(__file__)))
+    candidates = []
+    env_dir = os.environ.get("MXTPU_LIBRARY_PATH")
+    if env_dir:
+        candidates.append(env_dir)
+    candidates += [
+        os.path.join(curr, "native"),
+        os.path.join(curr, "..", "native"),
+    ]
+    found = []
+    for d in candidates:
+        for name in _LIB_NAMES:
+            p = os.path.join(d, name)
+            if os.path.exists(p) and os.path.isfile(p):
+                found.append(os.path.abspath(p))
+    if not found:
+        raise RuntimeError(
+            "Cannot find native runtime libraries %s in candidates:\n%s\n"
+            "Build them with `make -C native`."
+            % (list(_LIB_NAMES), "\n".join(candidates)))
+    return found

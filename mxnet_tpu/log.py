@@ -1,0 +1,85 @@
+"""Logging with colored level labels.
+
+Capability parity with python/mxnet/log.py (reference :19-127): a custom
+``logging.Formatter`` that prints ``date level message`` with ANSI-colored
+level labels on ttys, and a ``get_logger`` helper wiring it to stream or
+file handlers.
+"""
+from __future__ import annotations
+
+import logging
+import sys
+
+CRITICAL = logging.CRITICAL
+ERROR = logging.ERROR
+WARNING = logging.WARNING
+INFO = logging.INFO
+DEBUG = logging.DEBUG
+NOTSET = logging.NOTSET
+
+PY3 = sys.version_info[0] >= 3
+
+
+class _Formatter(logging.Formatter):
+    """Formatter: colored single-letter level label + time + message
+    (reference log.py:19-61)."""
+
+    _COLORS = {
+        logging.WARNING: "\x1b[33m",   # yellow
+        logging.ERROR: "\x1b[31m",     # red
+        logging.CRITICAL: "\x1b[35m",  # magenta
+    }
+    _LABELS = {
+        logging.CRITICAL: "C",
+        logging.ERROR: "E",
+        logging.WARNING: "W",
+        logging.INFO: "I",
+        logging.DEBUG: "D",
+    }
+
+    def __init__(self):
+        datefmt = "%m%d %H:%M:%S"
+        super().__init__(datefmt=datefmt)
+
+    def _get_color(self, level):
+        return self._COLORS.get(level, "\x1b[32m")  # default green
+
+    def _get_label(self, level):
+        return self._LABELS.get(level, "U")
+
+    def format(self, record):
+        fmt = ""
+        if sys.stderr.isatty():
+            fmt += self._get_color(record.levelno)
+        fmt += self._get_label(record.levelno)
+        fmt += "%(asctime)s %(process)d %(pathname)s:%(lineno)d"
+        if sys.stderr.isatty():
+            fmt += "\x1b[0m"
+        fmt += " %(message)s"
+        self._style._fmt = fmt
+        return super().format(record)
+
+
+def getLogger(name=None, filename=None, filemode=None, level=WARNING):
+    """Deprecated alias of :func:`get_logger` (reference log.py:62-71)."""
+    import warnings
+    warnings.warn("getLogger is deprecated, use get_logger instead",
+                  DeprecationWarning)
+    return get_logger(name, filename, filemode, level)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Get a logger configured with the mxnet formatter
+    (reference log.py:72-127). Handlers are attached only once per name."""
+    logger = logging.getLogger(name)
+    if name is not None and not getattr(logger, "_init_done", False):
+        logger._init_done = True
+        if filename:
+            mode = filemode if filemode else "a"
+            hdlr = logging.FileHandler(filename, mode)
+        else:
+            hdlr = logging.StreamHandler()
+        hdlr.setFormatter(_Formatter())
+        logger.addHandler(hdlr)
+        logger.setLevel(level)
+    return logger

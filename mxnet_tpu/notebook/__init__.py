@@ -1,0 +1,2 @@
+"""Notebook helpers (reference python/mxnet/notebook/)."""
+from . import callback

@@ -1,0 +1,98 @@
+"""Notebook training-visualization callbacks.
+
+Capability parity with python/mxnet/notebook/callback.py (reference
+:54-350): ``PandasLogger`` accumulates per-batch/epoch metrics into pandas
+DataFrames for notebook analysis/plotting; ``LiveLearningCurve`` is the
+live-plot variant (requires a display backend; here it reuses the same
+accumulation and exposes the dataframes). Dependencies are imported
+lazily and failures degrade to plain-dict storage.
+"""
+from __future__ import annotations
+
+import time
+
+
+def _try_pandas():
+    try:
+        import pandas as pd
+        return pd
+    except Exception:
+        return None
+
+
+class PandasLogger(object):
+    """Log train/eval metrics into pandas DataFrames
+    (reference notebook/callback.py:54-170).
+
+    Hook the instance's ``train_cb``/``eval_cb``/``epoch_cb`` methods into
+    ``Module.fit``'s batch_end/eval_end/epoch_end callbacks.
+    """
+
+    def __init__(self, batch_size, frequent=50):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self._start = time.time()
+        self._records = {"train": [], "eval": [], "epoch": []}
+        self._pd = _try_pandas()
+
+    def _df(self, name):
+        rows = self._records[name]
+        if self._pd is None:
+            return rows
+        return self._pd.DataFrame(rows)
+
+    @property
+    def train_df(self):
+        return self._df("train")
+
+    @property
+    def eval_df(self):
+        return self._df("eval")
+
+    @property
+    def epoch_df(self):
+        return self._df("epoch")
+
+    @property
+    def all_dataframes(self):
+        return {k: self._df(k) for k in self._records}
+
+    def elapsed(self):
+        return time.time() - self._start
+
+    def append_metrics(self, metrics, df_name):
+        row = dict(metrics)
+        row["elapsed"] = self.elapsed()
+        self._records[df_name].append(row)
+
+    def train_cb(self, param):
+        """batch_end_callback for training metrics."""
+        if param.nbatch % self.frequent != 0 or param.eval_metric is None:
+            return
+        metrics = dict(param.eval_metric.get_name_value())
+        metrics["epoch"] = param.epoch
+        metrics["nbatch"] = param.nbatch
+        self.append_metrics(metrics, "train")
+
+    def eval_cb(self, param):
+        """eval_end_callback for validation metrics."""
+        if param.eval_metric is None:
+            return
+        metrics = dict(param.eval_metric.get_name_value())
+        metrics["epoch"] = param.epoch
+        self.append_metrics(metrics, "eval")
+
+    def epoch_cb(self, epoch=None, symbol=None, arg_params=None,
+                 aux_params=None):
+        """epoch_end_callback stamping epoch wall time."""
+        self.append_metrics({"epoch": epoch}, "epoch")
+
+
+class LiveLearningCurve(PandasLogger):
+    """Accumulating learning-curve callback (reference
+    notebook/callback.py:172-350 draws with bokeh; headless builds keep
+    the same data surface and leave rendering to the notebook)."""
+
+    def __init__(self, metric_name="accuracy", frequent=50, batch_size=1):
+        super().__init__(batch_size=batch_size, frequent=frequent)
+        self.metric_name = metric_name

@@ -1,0 +1,109 @@
+"""Generic class registry factories.
+
+Capability parity with python/mxnet/registry.py (reference :15-141):
+``get_register_func``/``get_alias_func``/``get_create_func`` attach a
+string-keyed registry to a base class so subsystems (optimizers, metrics,
+initializers, augmenters, ...) can be registered by name and created from
+``"name"``, ``"json-config"`` or ``("name", kwargs)`` specs.
+"""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+_REGISTRY = {}  # base_class -> {lowercased name: klass}
+
+
+def get_registry(base_class):
+    """Return a copy of the name->class mapping registered for base_class."""
+    return dict(_REGISTRY.get(base_class, {}))
+
+
+def get_register_func(base_class, nickname):
+    """Make a decorator that registers subclasses of ``base_class``.
+
+    Mirrors reference registry.py:15-52 — re-registration warns and
+    overwrites, names are case-insensitive.
+    """
+    if base_class not in _REGISTRY:
+        _REGISTRY[base_class] = {}
+    registry = _REGISTRY[base_class]
+
+    def register(klass, name=None):
+        assert issubclass(klass, base_class), (
+            "Can only register subclass of %s" % base_class.__name__)
+        if name is None:
+            name = klass.__name__
+        name = name.lower()
+        if name in registry:
+            import logging
+            logging.warning(
+                "New %s %s.%s registered with name %s is overriding existing "
+                "%s %s.%s", nickname, klass.__module__, klass.__name__, name,
+                nickname, registry[name].__module__, registry[name].__name__)
+        registry[name] = klass
+        return klass
+
+    register.__doc__ = "Register %s to the %s factory" % (nickname, nickname)
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """Make a decorator that registers a class under extra alias names
+    (reference registry.py:53-79)."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+        return reg
+
+    alias.__doc__ = "Register %s under alias names" % nickname
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """Make a ``create(spec, **kwargs)`` factory (reference registry.py:80-141).
+
+    Accepts an existing instance, a registered name, a JSON string
+    ``'{"name": {...kwargs}}'``, or name plus kwargs.
+    """
+    if base_class not in _REGISTRY:
+        _REGISTRY[base_class] = {}
+    registry = _REGISTRY[base_class]
+
+    def create(*args, **kwargs):
+        if len(args):
+            name = args[0]
+            args = args[1:]
+        else:
+            name = kwargs.pop(nickname)
+        if isinstance(name, base_class):
+            assert not args and not kwargs, (
+                "%s is already an instance. Additional arguments are invalid"
+                % nickname)
+            return name
+        if isinstance(name, dict):
+            return create(**name)
+        assert isinstance(name, str), (
+            "%s must be of string type" % nickname)
+        if name.startswith("["):
+            assert not args and not kwargs
+            name, kw = json.loads(name)
+            return create(name, **kw)
+        if name.startswith("{"):
+            assert not args and not kwargs
+            cfg = json.loads(name)
+            return create(**cfg)
+        name = name.lower()
+        if name not in registry:
+            raise MXNetError(
+                "%s is not registered. Registered %ss: %s"
+                % (name, nickname, ", ".join(sorted(registry))))
+        return registry[name](*args, **kwargs)
+
+    create.__doc__ = "Create a %s instance from config" % nickname
+    return create

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Kill stray training processes on this host.
+
+Capability parity with tools/kill-mxnet.py in the reference: after a
+crashed distributed run, worker/server processes can linger; this greps
+the process table for python processes running the given program (default:
+anything importing mxnet_tpu) and SIGKILLs them, sparing itself.
+
+Usage: python tools/kill_mxnet.py [program_substring]
+"""
+import os
+import signal
+import subprocess
+import sys
+
+
+def main():
+    pattern = sys.argv[1] if len(sys.argv) > 1 else "mxnet_tpu"
+    me = os.getpid()
+    out = subprocess.run(["ps", "axo", "pid,command"], capture_output=True,
+                         text=True).stdout
+    killed = []
+    for line in out.splitlines()[1:]:
+        line = line.strip()
+        if not line:
+            continue
+        pid_str, _, cmd = line.partition(" ")
+        try:
+            pid = int(pid_str)
+        except ValueError:
+            continue
+        if pid == me or "kill_mxnet" in cmd:
+            continue
+        if "python" in cmd and pattern in cmd:
+            try:
+                os.kill(pid, signal.SIGKILL)
+                killed.append((pid, cmd))
+            except OSError:
+                pass
+    for pid, cmd in killed:
+        print("killed %d: %s" % (pid, cmd[:100]))
+    if not killed:
+        print("no matching processes")
+
+
+if __name__ == "__main__":
+    main()
