@@ -20,7 +20,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 BATCH = int(os.environ.get("BENCH_BATCH", "32"))
 BASELINE = 181.53  # P100 ResNet-50 training img/s
 WARMUP = 3
-ITERS = int(os.environ.get("BENCH_ITERS", "20"))
+ITERS = int(os.environ.get("BENCH_ITERS", "100"))
 
 
 def main():
@@ -32,8 +32,12 @@ def main():
 
     sym = models.get_symbol("resnet-50", num_classes=1000)
     data_shape = (BATCH, 3, 224, 224)
+    # bf16 compute / f32 master weights: the MXU-native mixed-precision path
+    # (executor compute_dtype; override with BENCH_DTYPE=float32).
+    cdtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     exe = sym.simple_bind(mx.Context("tpu", 0) if jax.default_backend() != "cpu"
                           else mx.cpu(), grad_req="write",
+                          compute_dtype=cdtype,
                           data=data_shape, softmax_label=(BATCH,))
     # init weights
     init = mx.initializer.Xavier(factor_type="in", magnitude=2.0)
@@ -49,7 +53,6 @@ def main():
     lr, momentum, wd = 0.05, 0.9, 1e-4
     param_names = [n for n in exe.arg_dict if n not in ("data", "softmax_label")]
 
-    @jax.jit
     def sgd_all(params, grads, moms):
         new_p, new_m = {}, {}
         for n in params:
@@ -59,28 +62,27 @@ def main():
             new_m[n] = m
         return new_p, new_m
 
-    moms = {n: jnp.zeros_like(exe.arg_dict[n]._data) for n in param_names}
+    # ONE fused XLA program per step (fwd+bwd+SGD, donated buffers) — the
+    # whole-step bulk-exec path (Executor.make_train_step).
+    step = exe.make_train_step(sgd_all)
+    params = {n: exe.arg_dict[n]._data for n in param_names}
+    moms = {n: jnp.zeros_like(v) for n, v in params.items()}
+    feed = {"data": x, "softmax_label": y}
 
-    def step():
-        exe.arg_dict["data"]._data = x
-        exe.arg_dict["softmax_label"]._data = y
-        exe.forward_backward()
-        params = {n: exe.arg_dict[n]._data for n in param_names}
-        grads = {n: exe.grad_dict[n]._data for n in param_names}
-        new_p, new_m = sgd_all(params, grads, moms)
-        for n in param_names:
-            exe.arg_dict[n]._data = new_p[n]
-            moms[n] = new_m[n]
-        return exe.outputs[0]
+    def sync():
+        # device->host readback of one element: a REAL sync even where
+        # block_until_ready is unreliable (tunneled device platforms).
+        import numpy as _np
+        return _np.asarray(jnp.reshape(outs[0], (-1,))[0])
 
     for _ in range(WARMUP):
-        out = step()
-    jax.block_until_ready(out._data if hasattr(out, "_data") else out)
+        outs, params, moms = step(params, moms, feed)
+    sync()
 
     t0 = time.perf_counter()
     for _ in range(ITERS):
-        out = step()
-    jax.block_until_ready(out._data if hasattr(out, "_data") else out)
+        outs, params, moms = step(params, moms, feed)
+    sync()
     dt = time.perf_counter() - t0
 
     imgs_per_sec = BATCH * ITERS / dt

@@ -15,28 +15,32 @@ from ..ndarray import NDArray
 
 
 def set_is_training(is_train):
-    """Set the global training-mode flag, returning the previous value
-    (reference contrib/autograd.py:14-33). Also toggles recording, as the
-    reference's single flag did both."""
+    """Set the global training-mode flag, returning the previous training
+    value (reference contrib/autograd.py:14-33). Also toggles recording,
+    as the reference's single flag did both."""
     prev_t = _ag.set_training(bool(is_train))
-    prev_r = _ag.set_recording(bool(is_train))
-    return prev_t and prev_r
+    _ag.set_recording(bool(is_train))
+    return prev_t
 
 
 class TrainingStateScope(object):
     """Scope manager for switching training state
-    (reference contrib/autograd.py:34-53)."""
+    (reference contrib/autograd.py:34-53). Saves and restores the
+    training and recording flags independently so nesting inside
+    mx.autograd.record(train_mode=...) scopes is lossless."""
 
     def __init__(self, enter_state):
         self._enter_state = enter_state
-        self._prev = None
+        self._prev_t = None
+        self._prev_r = None
 
     def __enter__(self):
-        self._prev = set_is_training(self._enter_state)
+        self._prev_t = _ag.set_training(self._enter_state)
+        self._prev_r = _ag.set_recording(self._enter_state)
 
     def __exit__(self, ptype, value, trace):
-        if self._prev != self._enter_state:
-            set_is_training(self._prev)
+        _ag.set_training(self._prev_t)
+        _ag.set_recording(self._prev_r)
 
 
 def train_section():

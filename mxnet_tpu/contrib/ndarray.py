@@ -21,4 +21,5 @@ for _name in _CONTRIB_OPS:
 if hasattr(_nd, "ctc_loss"):
     CTCLoss = _nd.ctc_loss
 
-__all__ = [n for n in _CONTRIB_OPS if n in globals()] + ["CTCLoss"]
+__all__ = [n for n in _CONTRIB_OPS if n in globals()] + (
+    ["CTCLoss"] if "CTCLoss" in globals() else [])

@@ -19,4 +19,5 @@ for _name in _CONTRIB_OPS:
 if hasattr(_sym, "ctc_loss"):
     CTCLoss = _sym.ctc_loss
 
-__all__ = [n for n in _CONTRIB_OPS if n in globals()] + ["CTCLoss"]
+__all__ = [n for n in _CONTRIB_OPS if n in globals()] + (
+    ["CTCLoss"] if "CTCLoss" in globals() else [])

@@ -19,6 +19,7 @@ class LogMetricsCallback(object):
     def __init__(self, logging_dir, prefix=None):
         self.prefix = prefix
         self.history = []  # (name, value) record kept even without a writer
+        self._step = 0
         try:
             from torch.utils.tensorboard import SummaryWriter
             self.summary_writer = SummaryWriter(logging_dir)
@@ -30,9 +31,10 @@ class LogMetricsCallback(object):
         if param.eval_metric is None:
             return
         name_value = param.eval_metric.get_name_value()
+        self._step += 1
         for name, value in name_value:
             if self.prefix is not None:
                 name = "%s-%s" % (self.prefix, name)
             self.history.append((name, value))
             if self.summary_writer is not None:
-                self.summary_writer.add_scalar(name, value)
+                self.summary_writer.add_scalar(name, value, self._step)

@@ -33,10 +33,39 @@ from .context import Context, default_context
 from .ndarray import NDArray
 
 
+def _cast_floats(tree, dtype, src=None):
+    """Cast float leaves of a list/dict tree to dtype (inside jit, so XLA
+    fuses the converts into neighbouring ops). Only leaves of dtype `src`
+    (default float32) are touched, so integer/bool leaves pass through."""
+    src = jnp.float32 if src is None else jnp.dtype(src)
+
+    def cast(v):
+        if hasattr(v, "dtype") and v.dtype == src:
+            return v.astype(dtype)
+        return v
+    return jax.tree_util.tree_map(cast, tree)
+
+
 class Executor:
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
-                 aux_states=None, group2ctx=None, shared_exec=None):
+                 aux_states=None, group2ctx=None, shared_exec=None,
+                 compute_dtype=None):
+        """compute_dtype: optional low-precision compute dtype ("bfloat16").
+        Mixed precision the TPU-native way: parameters, gradients, and
+        optimizer state stay float32 (master weights); inside the single
+        jitted graph all float32 leaves are cast to compute_dtype so matmuls
+        and convs hit the MXU's bf16 path, and outputs/gradients are cast
+        back to float32. This is the analogue of the reference's fp16
+        training path (Cast ops + float16 data, tests/python/train/
+        test_dtype.py) — bf16 needs no loss scaling, unlike fp16.
+        Default from MXNET_COMPUTE_DTYPE env var."""
         self._symbol = symbol
+        import os as _os
+        if compute_dtype is None:
+            compute_dtype = _os.environ.get("MXNET_COMPUTE_DTYPE") or None
+        self._compute_dtype = (jnp.dtype(compute_dtype)
+                               if compute_dtype not in (None, "", "float32")
+                               else None)
         self._ctx = ctx if isinstance(ctx, Context) else (ctx[0] if ctx else default_context())
         self._group2ctx = group2ctx
         arg_names = symbol.list_arguments()
@@ -105,9 +134,17 @@ class Executor:
         fn = self._fwd_cache.get(is_train)
         if fn is None:
             eval_fn = self._eval_fn
+            cd = self._compute_dtype
 
             def fwd(arg_values, aux_values, rng):
-                return eval_fn(arg_values, aux_values, is_train, rng)
+                if cd is not None:
+                    arg_values = _cast_floats(arg_values, cd)
+                    aux_values = _cast_floats(aux_values, cd)
+                outs, aux_up = eval_fn(arg_values, aux_values, is_train, rng)
+                if cd is not None:
+                    outs = _cast_floats(outs, jnp.float32, src=cd)
+                    aux_up = _cast_floats(aux_up, jnp.float32, src=cd)
+                return outs, aux_up
 
             fn = jax.jit(fwd)
             self._fwd_cache[is_train] = fn
@@ -121,6 +158,8 @@ class Executor:
             grad_names = [n for n in self._arg_names if self.grad_req.get(n) != "null"]
             reqs = tuple(self.grad_req[n] for n in grad_names)
 
+            cd = self._compute_dtype
+
             def fwd_bwd(arg_values, aux_values, rng, head_grads, old_grads):
                 grad_vals = [arg_values[n] for n in grad_names]
 
@@ -128,7 +167,16 @@ class Executor:
                     av = dict(arg_values)
                     for n, v in zip(grad_names, gvals):
                         av[n] = v
-                    outs, aux_up = eval_fn(av, aux_values, True, rng)
+                    auxv = aux_values
+                    if cd is not None:
+                        # bf16 compute; vjp of the cast returns f32 grads
+                        # (transpose of convert_element_type casts back).
+                        av = _cast_floats(av, cd)
+                        auxv = _cast_floats(auxv, cd)
+                    outs, aux_up = eval_fn(av, auxv, True, rng)
+                    if cd is not None:
+                        outs = _cast_floats(outs, jnp.float32, src=cd)
+                        aux_up = _cast_floats(aux_up, jnp.float32, src=cd)
                     return outs, aux_up
 
                 (outs, aux_up), vjp = jax.vjp(lambda *g: f(*g), *grad_vals, has_aux=False)
@@ -143,6 +191,73 @@ class Executor:
             self._fwd_bwd_fn = jax.jit(fwd_bwd, donate_argnums=(4,))
             self._grad_names = grad_names
         return self._fwd_bwd_fn
+
+    def make_train_step(self, update_fn):
+        """Build ONE jitted computation for a whole training step:
+        forward + backward + optimizer update, with parameter and
+        optimizer-state buffers donated so XLA updates them in place.
+
+        This is the full-fusion analogue of the reference's bulk segment
+        execution (graph_executor.cc:681-759 batches ops into one engine op;
+        here the step — including the update the reference runs as separate
+        fused optimizer kernels, optimizer_op.cc — is a single XLA program,
+        so per-step host work is one dispatch and one pytree flatten).
+
+        update_fn(params, grads, states) -> (new_params, new_states) must be
+        pure/traceable (e.g. built from optimizer.create's update rule).
+        Returns step(params, states, data_values: dict) ->
+        (outputs, new_params, new_states). `params` covers the grad-bearing
+        args; `data_values` the rest (data/label). Aux states (BN stats) are
+        threaded internally and updated in place on self.aux_dict.
+
+        DONATION CONTRACT: the params/states passed to step() are consumed
+        (their device buffers are reused for the outputs — kWriteInplace).
+        Do not alias them with live NDArrays; thread the returned values
+        into the next call.
+        """
+        eval_fn = self._eval_fn
+        grad_names = list(self._grad_names_list())
+        data_names = [n for n in self._arg_names if n not in set(grad_names)]
+        cd = self._compute_dtype
+
+        def step(params, states, aux_values, rng, data_values):
+            def f(p):
+                av = dict(data_values)
+                av.update(p)
+                auxv = aux_values
+                if cd is not None:
+                    av = _cast_floats(av, cd)
+                    auxv = _cast_floats(auxv, cd)
+                outs, aux_up = eval_fn(av, auxv, True, rng)
+                if cd is not None:
+                    outs = _cast_floats(outs, jnp.float32, src=cd)
+                    aux_up = _cast_floats(aux_up, jnp.float32, src=cd)
+                return outs, aux_up
+
+            (outs, aux_up), vjp = jax.vjp(f, params)
+            (grads,) = vjp(([jnp.ones_like(o) for o in outs],
+                            {k: jnp.zeros_like(v) for k, v in aux_up.items()}))
+            new_params, new_states = update_fn(params, grads, states)
+            return outs, new_params, new_states, aux_up
+
+        jitted = jax.jit(step, donate_argnums=(0, 1))
+
+        def run(params, states, data_values):
+            rng = self._next_rng()
+            aux_values = {n: a._data for n, a in self.aux_dict.items()}
+            dv = {n: (v._data if isinstance(v, NDArray) else jnp.asarray(v))
+                  for n, v in data_values.items()}
+            for n in data_names:
+                if n not in dv and n in self.arg_dict:
+                    dv[n] = self.arg_dict[n]._data
+            outs, new_params, new_states, aux_up = jitted(
+                params, states, aux_values, rng, dv)
+            for n, v in aux_up.items():
+                self.aux_dict[n]._data = v
+            self.outputs = [NDArray(o) for o in outs]
+            return outs, new_params, new_states
+
+        return run
 
     def _next_rng(self):
         self._last_rng = _random.next_key()
@@ -248,7 +363,8 @@ class Executor:
         grads = None
         if self.grad_dict:
             grads = {n: nd.zeros(a.shape, dtype=str(a._data.dtype)) for n, a in new_args.items() if n in self.grad_dict}
-        return Executor(self._symbol, self._ctx, new_args, grads, self.grad_req, new_aux)
+        return Executor(self._symbol, self._ctx, new_args, grads, self.grad_req,
+                        new_aux, compute_dtype=self._compute_dtype)
 
     # --- monitor (reference graph_executor.cc:761-781 monitor callback) ---
     def set_monitor_callback(self, callback):

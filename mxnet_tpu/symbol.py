@@ -361,14 +361,15 @@ class Symbol:
 
     # --- binding ----------------------------------------------------------
     def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
-             group2ctx=None, shared_exec=None):
+             group2ctx=None, shared_exec=None, compute_dtype=None):
         from .executor import Executor
 
         return Executor(self, ctx, args, args_grad, grad_req, aux_states,
-                        group2ctx=group2ctx, shared_exec=shared_exec)
+                        group2ctx=group2ctx, shared_exec=shared_exec,
+                        compute_dtype=compute_dtype)
 
     def simple_bind(self, ctx, grad_req="write", type_dict=None, group2ctx=None,
-                    shared_exec=None, **kwargs):
+                    shared_exec=None, compute_dtype=None, **kwargs):
         """Infer shapes from kwargs, allocate arrays, bind (reference
         python/mxnet/symbol.py:1117)."""
         from . import ndarray as nd
@@ -394,7 +395,8 @@ class Symbol:
             for n, shp in zip(aux_names, aux_shapes)
         }
         return Executor(self, ctx, args, args_grad, grad_req, aux_states,
-                        group2ctx=group2ctx, shared_exec=shared_exec)
+                        group2ctx=group2ctx, shared_exec=shared_exec,
+                        compute_dtype=compute_dtype)
 
     # --- evaluation helper used by Executor -------------------------------
     def build_eval(self):
