@@ -184,8 +184,10 @@ class Executor:
                     head_grads = [jnp.ones_like(o) for o in outs]
                 grads = vjp((list(head_grads), {k: jnp.zeros_like(v) for k, v in aux_up.items()}))
                 new_grads = []
-                for g, req, old in zip(grads, reqs, old_grads):
-                    new_grads.append(old + g if req == "add" else g)
+                for n, g, req in zip(grad_names, grads, reqs):
+                    # old_grads holds ONLY add-req buffers; write-req grads
+                    # need no host-side zeros (kAddTo vs kWriteTo)
+                    new_grads.append(old_grads[n] + g if req == "add" else g)
                 return outs, aux_up, new_grads
 
             self._fwd_bwd_fn = jax.jit(fwd_bwd, donate_argnums=(4,))
@@ -203,8 +205,10 @@ class Executor:
         fused optimizer kernels, optimizer_op.cc — is a single XLA program,
         so per-step host work is one dispatch and one pytree flatten).
 
-        update_fn(params, grads, states) -> (new_params, new_states) must be
-        pure/traceable (e.g. built from optimizer.create's update rule).
+        update_fn(params, grads, states, *extra) -> (new_params, new_states)
+        must be pure/traceable (e.g. built from optimizer.create's update
+        rule); extra positional args to step() are forwarded to it as traced
+        values (dynamic lr/wd arrays and the like).
         Returns step(params, states, data_values: dict) ->
         (outputs, new_params, new_states). `params` covers the grad-bearing
         args; `data_values` the rest (data/label). Aux states (BN stats) are
@@ -220,7 +224,7 @@ class Executor:
         data_names = [n for n in self._arg_names if n not in set(grad_names)]
         cd = self._compute_dtype
 
-        def step(params, states, aux_values, rng, data_values):
+        def step(params, states, aux_values, rng, data_values, *extra):
             def f(p):
                 av = dict(data_values)
                 av.update(p)
@@ -237,12 +241,12 @@ class Executor:
             (outs, aux_up), vjp = jax.vjp(f, params)
             (grads,) = vjp(([jnp.ones_like(o) for o in outs],
                             {k: jnp.zeros_like(v) for k, v in aux_up.items()}))
-            new_params, new_states = update_fn(params, grads, states)
+            new_params, new_states = update_fn(params, grads, states, *extra)
             return outs, new_params, new_states, aux_up
 
         jitted = jax.jit(step, donate_argnums=(0, 1))
 
-        def run(params, states, data_values):
+        def run(params, states, data_values, *extra):
             rng = self._next_rng()
             aux_values = {n: a._data for n, a in self.aux_dict.items()}
             dv = {n: (v._data if isinstance(v, NDArray) else jnp.asarray(v))
@@ -251,7 +255,7 @@ class Executor:
                 if n not in dv and n in self.arg_dict:
                     dv[n] = self.arg_dict[n]._data
             outs, new_params, new_states, aux_up = jitted(
-                params, states, aux_values, rng, dv)
+                params, states, aux_values, rng, dv, *extra)
             for n, v in aux_up.items():
                 self.aux_dict[n]._data = v
             self.outputs = [NDArray(o) for o in outs]
@@ -292,10 +296,8 @@ class Executor:
         aux_values = {n: a._data for n, a in self.aux_dict.items()}
         rng = self._last_rng if self._last_rng is not None else self._next_rng()
         heads = None if out_grads is None else [g._data for g in out_grads]
-        old = [
-            self.grad_dict[n]._data if self.grad_req[n] == "add" else jnp.zeros_like(self.grad_dict[n]._data)
-            for n in self._grad_names_list()
-        ]
+        old = {n: self.grad_dict[n]._data for n in self._grad_names_list()
+               if self.grad_req[n] == "add"}
         outs, aux_up, new_grads = fn(arg_values, aux_values, rng, heads, old)
         for n, g in zip(self._grad_names_list(), new_grads):
             self.grad_dict[n]._data = g

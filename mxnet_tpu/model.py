@@ -68,7 +68,9 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None):
-    """(reference model.py:99-122)."""
+    """(reference model.py:99-122). All per-key updates are batched into one
+    jitted program per device slot via Updater.update_all."""
+    per_slot = {}
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list[0] is None:
@@ -78,7 +80,9 @@ def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None)
             kvstore.pull(index, grad_list, priority=-index)
         for k, p in enumerate(zip(arg_list, grad_list)):
             w, g = p
-            updater(index * num_device + k, g, w)
+            per_slot.setdefault(k, []).append((index * num_device + k, g, w))
+    for pairs in per_slot.values():
+        updater.update_all(pairs)
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):

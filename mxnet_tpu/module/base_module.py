@@ -68,6 +68,13 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def fit_step(self, data_batch):
+        """One training step inside fit(): forward_backward + update.
+        Subclasses may override with a fully fused implementation (Module
+        runs fwd+bwd+optimizer as ONE donated XLA program when eligible)."""
+        self.forward_backward(data_batch)
+        self.update()
+
     def score(self, eval_data, eval_metric, num_batch=None, batch_end_callback=None,
               score_end_callback=None, reset=True, epoch=0):
         """Evaluate on eval_data (reference base_module.py:213)."""
@@ -176,8 +183,7 @@ class BaseModule:
             for nbatch, data_batch in enumerate(train_data):
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
+                self.fit_step(data_batch)
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()

@@ -149,10 +149,23 @@ class DataParallelExecutorGroup:
         return self._exec.outputs
 
     def _put(self, target: NDArray, value):
-        arr = value.asnumpy() if isinstance(value, NDArray) else np.asarray(value)
+        # Keep device arrays on device: an NDArray batch feeds straight into
+        # device_put (device-to-device, often a no-op) — no host round-trip.
+        # The reference's H2D copy is likewise engine-async (SURVEY §3.5).
+        tgt_dtype = target._data.dtype
+        if isinstance(value, NDArray):
+            arr = value._data
+            if arr.dtype != tgt_dtype:
+                arr = arr.astype(tgt_dtype)
+        else:
+            arr = np.asarray(value).astype(np.dtype(tgt_dtype), copy=False)
         if self._single:
-            target._data = jax.device_put(arr.astype(np.asarray(target._data).dtype, copy=False),
-                                          self.contexts[0].jax_device())
+            dev = self.contexts[0].jax_device()
+            if isinstance(arr, jax.Array) and not arr.is_deleted() \
+                    and arr.sharding.device_set == {dev}:
+                target._data = arr  # already resident: no transfer
+            else:
+                target._data = jax.device_put(arr, dev)
         else:
             sharding = (
                 self._data_sharding

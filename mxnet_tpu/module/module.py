@@ -71,6 +71,9 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        self._fused_fit = None      # lazy fused fit-step state
+        self._fused_dirty = False   # fused params newer than exec buffers
+        self._monitor_installed = False
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -137,6 +140,11 @@ class Module(BaseModule):
         if self.params_initialized and not force_init:
             return
         assert self.binded, "call bind before initializing the parameters"
+        # a fused fit-step threads (donated) parameter buffers of its own;
+        # materialize them into the exec buffers, then drop the fused state
+        # so explicitly-set parameters take effect on the next step
+        self._sync_fused_to_exec()
+        self._fused_fit = None
 
         if self._arg_params is None:
             self._arg_params = {
@@ -234,6 +242,8 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        self._fused_fit = None
+        self._fused_dirty = False
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),), force_init=False):
@@ -279,6 +289,8 @@ class Module(BaseModule):
             self._updater = opt.get_updater(optimizer)
 
         self.optimizer_initialized = True
+        self._sync_fused_to_exec()
+        self._fused_fit = None  # re-evaluate fused eligibility
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
@@ -296,6 +308,7 @@ class Module(BaseModule):
     # --- computations -----------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        self._sync_fused_to_exec()
         self._exec_group.forward(data_batch, is_train)
 
     def backward(self, out_grads=None):
@@ -335,11 +348,13 @@ class Module(BaseModule):
         return self._exec_group.get_input_grads(merge_multi_context=merge_multi_context)
 
     def _sync_params_from_devices(self):
+        self._sync_fused_to_exec()
         self._exec_group.get_params(self._arg_params, self._aux_params)
         self._params_dirty = False
 
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
+        self._sync_fused_to_exec()
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
@@ -348,12 +363,131 @@ class Module(BaseModule):
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
+        self._sync_fused_to_exec()  # keep fused params; pre-load states moot
+        self._fused_fit = None      # rebuild so loaded states are picked up
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:
             with open(fname, "rb") as f:
                 self._updater.set_states(f.read())
 
+    # --- fused fit step ---------------------------------------------------
+    def fit_step(self, data_batch):
+        """ONE donated XLA program per training step (fwd + bwd + optimizer;
+        Executor.make_train_step) when the setup allows it — the whole-step
+        analogue of the reference's bulk segments + fused optimizer kernels
+        (graph_executor.cc:681-759, optimizer_op.cc). Parameters and
+        optimizer state are threaded functionally through donated buffers;
+        exec/arg_params buffers are refreshed lazily on get_params/eval.
+        Falls back to forward_backward + update otherwise."""
+        fs = self._fused_fit_state()
+        if fs is None:
+            self.forward_backward(data_batch)
+            self.update()
+            return
+        import numpy as _np
+        import jax.numpy as _jnp
+
+        opt_ = self._optimizer
+        idx_of = fs["idx_of"]
+        for n in fs["names"]:
+            opt_._update_count(idx_of[n])
+        lw = _np.array([opt_.effective_lr_wd(idx_of[n]) for n in fs["names"]],
+                       _np.float32)
+        # place the batch with the group's device/sharding logic; the step
+        # then reads the executor's data buffers (empty feed dict).
+        self._exec_group._load_data(data_batch)
+        _, fs["params"], fs["states"] = fs["step"](
+            fs["params"], fs["states"], {},
+            _jnp.asarray(lw[:, 0]), _jnp.asarray(lw[:, 1]))
+        self._params_dirty = True
+        self._fused_dirty = True
+
+    def _fused_fit_state(self):
+        """Build (once) or fetch the fused-step state; None if ineligible."""
+        if self._fused_fit is not None:
+            return self._fused_fit or None
+        import os
+        eligible = (
+            os.environ.get("MXNET_FUSED_FIT", "1") != "0"
+            and self.optimizer_initialized
+            and self._kvstore is None
+            and not self._update_on_kvstore
+            and self._optimizer is not None
+            and self._optimizer.pure_rule() is not None
+            and not self.inputs_need_grad
+            and not self._monitor_installed
+        )
+        exec_ = self._exec_group._exec
+        names = list(self._exec_group.param_names)
+        if eligible and any(exec_.grad_req.get(n) != "write" for n in names):
+            eligible = False
+        if not eligible:
+            self._fused_fit = False  # cache the negative
+            return None
+        rule = self._optimizer.pure_rule()
+        # same state keying as the unfused path (model.py _update_params:
+        # index*num_device, single device slot 0 in the sharded-exec design)
+        nd_dev = len(self._context)
+        idx_of = {n: i * nd_dev for i, n in enumerate(names)}
+
+        def update_fn(params, grads, states, lr_arr, wd_arr):
+            new_p, new_s = {}, {}
+            for pos, n in enumerate(names):
+                new_p[n], new_s[n] = rule(params[n], grads[n], states[n],
+                                          lr_arr[pos], wd_arr[pos])
+            return new_p, new_s
+
+        import jax.numpy as _jnp
+
+        step = exec_.make_train_step(update_fn)
+        # device-side copies: the step donates these, and donation must not
+        # delete buffers aliased by exec arg_dict / user-held NDArrays
+        params = {n: _jnp.array(exec_.arg_dict[n]._data, copy=True)
+                  for n in names}
+        states = {}
+        for n in names:
+            i = idx_of[n]
+            if i not in self._updater.states:
+                self._updater.states[i] = self._optimizer.create_state(
+                    i, exec_.arg_dict[n])
+            st = self._updater.states[i]
+            if st is None:
+                states[n] = None
+            elif isinstance(st, tuple):
+                states[n] = tuple(
+                    None if x is None else _jnp.array(x._data, copy=True)
+                    for x in st)
+            else:
+                states[n] = _jnp.array(st._data, copy=True)
+        self._fused_fit = {"step": step, "params": params, "states": states,
+                           "names": names, "idx_of": idx_of}
+        return self._fused_fit
+
+    def _sync_fused_to_exec(self):
+        """Refresh executor arg buffers + updater state NDArrays from the
+        fused step's threaded (donated) values."""
+        fs = self._fused_fit
+        if not fs or not self._fused_dirty:
+            return
+        exec_ = self._exec_group._exec
+        for n in fs["names"]:
+            exec_.arg_dict[n]._data = fs["params"][n]
+            st = self._updater.states.get(fs["idx_of"][n])
+            leaf = fs["states"][n]
+            if st is None:
+                continue
+            if isinstance(st, tuple):
+                for old, val in zip(st, leaf):
+                    if old is not None:
+                        old._data = val
+            else:
+                st._data = leaf
+        self._fused_dirty = False
+
     def install_monitor(self, mon):
         assert self.binded
+        self._monitor_installed = True
+        self._sync_fused_to_exec()
+        self._fused_fit = None  # monitor needs per-op taps: unfused path
         self._exec_group.install_monitor(mon)

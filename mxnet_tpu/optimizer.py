@@ -82,6 +82,33 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         raise NotImplementedError()
 
+    def effective_lr_wd(self, index):
+        """(lr, wd) actually applied for this key at the current step —
+        schedule, lr/wd multipliers, and any step-count folding (Adam bias
+        correction) resolved host-side so the device rule stays static."""
+        return self._get_lr(index), self._get_wd(index)
+
+    def pure_rule(self):
+        """Return fn(w, g, state, lr, wd) -> (new_w, new_state), a pure
+        traceable update with hyperparameters closed over, or None if this
+        optimizer has no pure form (then the per-key eager path is used).
+        lr/wd arrive as dynamic scalars so LR schedules don't retrace.
+        Other hyperparameters (momentum, betas, rescale_grad, clip) are
+        baked in at trace time — callers caching a compiled rule must
+        re-trace if they mutate them (Updater.update_all keys its cache on
+        rescale_grad/clip_gradient for this reason).
+        Enables Updater.update_all: the whole parameter tree updated in ONE
+        jitted program — the analogue of the reference running its fused
+        optimizer kernels (optimizer_op.cc) inside engine bulk segments."""
+        return None
+
+    def _pure_prep_grad(self, g, w, wd):
+        import jax.numpy as jnp
+        g = g * self.rescale_grad
+        if self.clip_gradient is not None and self.clip_gradient > 0:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g + wd * w
+
     def set_lr_mult(self, args_lr_mult):
         self.lr_mult.update(args_lr_mult)
 
@@ -162,6 +189,18 @@ class SGD(Optimizer):
             weight._data = res[0]._data
             state._data = res[1]._data
 
+    def pure_rule(self):
+        mom = self.momentum
+
+        def rule(w, g, s, lr, wd):
+            g = self._pure_prep_grad(g, w, wd)
+            if s is None:
+                return w - lr * g, None
+            m = mom * s - lr * g
+            return w + m, m
+
+        return rule
+
 
 @register
 class NAG(SGD):
@@ -183,6 +222,18 @@ class NAG(SGD):
             weight._data = (weight - lr * g)._data
         else:
             weight._data = (weight - lr * (g + wd * weight))._data
+
+    def pure_rule(self):
+        mom = self.momentum
+
+        def rule(w, g, s, lr, wd):
+            g = self._pure_prep_grad(g, w, wd)  # rescale+clip+wd, as update()
+            if s is None:
+                return w - lr * g, None
+            m = s * mom + g
+            return w - lr * (g + mom * m), m
+
+        return rule
 
 
 @register
@@ -256,6 +307,27 @@ class Adam(Optimizer):
     def create_state(self, index, weight):
         return (_zeros_like_state(weight), _zeros_like_state(weight))
 
+    def effective_lr_wd(self, index):
+        # fold bias correction into lr host-side (reference optimizer.py Adam)
+        t = self._index_update_count.get(index, self.begin_num_update) or 1
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        return lr * math.sqrt(coef2) / coef1, wd
+
+    def pure_rule(self):
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+
+        def rule(w, g, s, lr, wd):
+            import jax.numpy as jnp
+            mean, var = s
+            g = self._pure_prep_grad(g, w, wd)
+            mean_t = b1 * mean + (1 - b1) * g
+            var_t = b2 * var + (1 - b2) * jnp.square(g)
+            return w - lr * mean_t / (jnp.sqrt(var_t) + eps), (mean_t, var_t)
+
+        return rule
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         t = self._index_update_count[index]
@@ -294,6 +366,19 @@ class AdaGrad(Optimizer):
         history = state
         history._data = (history + g * g)._data
         weight._data = (weight - lr * (g / nd.sqrt(history + self.float_stable_eps) + wd * weight))._data
+
+    def pure_rule(self):
+        eps = self.float_stable_eps
+
+        def rule(w, g, s, lr, wd):
+            import jax.numpy as jnp
+            g = g * self.rescale_grad
+            if self.clip_gradient is not None and self.clip_gradient > 0:
+                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+            h = s + g * g
+            return w - lr * (g / jnp.sqrt(h + eps) + wd * w), h
+
+        return rule
 
 
 @register
@@ -338,6 +423,32 @@ class RMSProp(Optimizer):
             g._data = res[2]._data
             delta._data = res[3]._data
 
+    def pure_rule(self):
+        g1, g2, eps = self.gamma1, self.gamma2, self.epsilon
+        cw = self.clip_weights if self.clip_weights else -1.0
+        centered = self.centered
+
+        def rule(w, g, s, lr, wd):
+            import jax.numpy as jnp
+            g = self._pure_prep_grad(g, w, wd)
+            if not centered:
+                (n,) = s
+                n_t = (1 - g1) * jnp.square(g) + g1 * n
+                w_t = w - lr * g / jnp.sqrt(n_t + eps)
+                if cw > 0:
+                    w_t = jnp.clip(w_t, -cw, cw)
+                return w_t, (n_t,)
+            n, gs, delta = s
+            n_t = (1 - g1) * jnp.square(g) + g1 * n
+            g_t = (1 - g1) * g + g1 * gs
+            d_t = g2 * delta - lr * g / jnp.sqrt(n_t - jnp.square(g_t) + eps)
+            w_t = w + d_t
+            if cw > 0:
+                w_t = jnp.clip(w_t, -cw, cw)
+            return w_t, (n_t, g_t, d_t)
+
+        return rule
+
 
 @register
 class AdaDelta(Optimizer):
@@ -360,6 +471,22 @@ class AdaDelta(Optimizer):
         current_delta = nd.sqrt(acc_delta + self.epsilon) / nd.sqrt(acc_g + self.epsilon) * g
         acc_delta._data = (self.rho * acc_delta + (1 - self.rho) * current_delta * current_delta)._data
         weight._data = (weight - current_delta - wd * weight)._data
+
+    def pure_rule(self):
+        rho, eps = self.rho, self.epsilon
+
+        def rule(w, g, s, lr, wd):
+            import jax.numpy as jnp
+            g = g * self.rescale_grad
+            if self.clip_gradient is not None and self.clip_gradient > 0:
+                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+            acc_g, acc_d = s
+            acc_g_t = rho * acc_g + (1 - rho) * g * g
+            cur = jnp.sqrt(acc_d + eps) / jnp.sqrt(acc_g_t + eps) * g
+            acc_d_t = rho * acc_d + (1 - rho) * cur * cur
+            return w - cur - wd * w, (acc_g_t, acc_d_t)
+
+        return rule
 
 
 @register
@@ -415,11 +542,86 @@ class Updater:
     def __init__(self, optimizer: Optimizer):
         self.optimizer = optimizer
         self.states = {}
+        self._tree_fn = None
+        self._tree_keys = None
 
     def __call__(self, index, grad, weight):
         if index not in self.states:
             self.states[index] = self.optimizer.create_state(index, weight)
         self.optimizer.update(index, weight, grad, self.states[index])
+
+    def update_all(self, pairs):
+        """Apply the optimizer to many (index, grad, weight) pairs in ONE
+        jitted XLA program (optimizer.pure_rule), instead of one dispatch
+        per key — the whole-tree analogue of the reference executing its
+        fused optimizer kernels (optimizer_op.cc) under engine bulk
+        segments. Falls back to per-key eager updates when the optimizer
+        has no pure rule. lr/wd enter as dynamic scalars (no retrace when
+        an LR schedule changes them)."""
+        import jax
+        import jax.numpy as jnp
+
+        rule = self.optimizer.pure_rule()
+        if rule is None:
+            for index, grad, weight in pairs:
+                self(index, grad, weight)
+            return
+        opt = self.optimizer
+        for index, _, weight in pairs:
+            if index not in self.states:
+                self.states[index] = opt.create_state(index, weight)
+            opt._update_count(index)
+
+        def to_leaves(state):
+            if state is None:
+                return None
+            if isinstance(state, tuple):
+                return tuple(x if x is None else x._data for x in state)
+            return state._data
+
+        keys = tuple(sorted(p[0] for p in pairs))
+        by_idx = {p[0]: p for p in pairs}
+        weights = {str(i): by_idx[i][2]._data for i in keys}
+        grads = {str(i): by_idx[i][1]._data for i in keys}
+        states = {str(i): to_leaves(self.states[i]) for i in keys}
+        # lr/wd ship as TWO stacked arrays (one h2d transfer each), not
+        # hundreds of scalar buffers; indexed inside the jitted program.
+        lw = np.array([opt.effective_lr_wd(i) for i in keys], np.float32)
+        lr_arr = jnp.asarray(lw[:, 0])
+        wd_arr = jnp.asarray(lw[:, 1])
+
+        if (self._tree_fn is None or self._tree_keys != keys
+                or getattr(self, "_tree_hyper", None) !=
+                   (opt.rescale_grad, opt.clip_gradient)):
+            def tree_update(weights, grads, states, lr_arr, wd_arr):
+                new_w, new_s = {}, {}
+                for pos, i in enumerate(keys):
+                    k = str(i)
+                    new_w[k], new_s[k] = rule(weights[k], grads[k],
+                                              states[k], lr_arr[pos],
+                                              wd_arr[pos])
+                return new_w, new_s
+
+            # donate only the states: weight buffers can be aliased by
+            # user-held NDArrays (set_params / _put fast path), and donation
+            # would delete them under the caller
+            self._tree_fn = jax.jit(tree_update, donate_argnums=(2,))
+            self._tree_keys = keys
+            self._tree_hyper = (opt.rescale_grad, opt.clip_gradient)
+
+        new_w, new_s = self._tree_fn(weights, grads, states, lr_arr, wd_arr)
+        for i in keys:
+            k = str(i)
+            by_idx[i][2]._data = new_w[k]
+            st, new = self.states[i], new_s[k]
+            if st is None:
+                continue
+            if isinstance(st, tuple):
+                for old, val in zip(st, new):
+                    if old is not None:
+                        old._data = val
+            else:
+                st._data = new
 
     def set_states(self, states):
         blob = pickle.loads(states)

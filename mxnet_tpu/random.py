@@ -17,10 +17,15 @@ _state = {"key": None, "seed": 0}
 
 def seed(seed_state: int) -> None:
     """Seed the global generator (reference: python/mxnet/random.py seed /
-    MXRandomSeed)."""
+    MXRandomSeed). Covers both the jax key stream (device-side sampling
+    ops) and numpy's global state (host-side initializers, io shuffles),
+    as the reference's seed covers all of MXNet's RNG streams."""
+    import numpy as np
+
     with _lock:
         _state["seed"] = int(seed_state)
         _state["key"] = jax.random.PRNGKey(int(seed_state))
+        np.random.seed(int(seed_state) & 0x7FFFFFFF)
 
 
 def next_key():

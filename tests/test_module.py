@@ -148,3 +148,141 @@ def test_sequential_module():
     seq.forward(batch)
     out = seq.get_outputs()[0]
     assert out.shape == (16, 3)
+
+
+def test_fused_fit_step_matches_unfused():
+    """Module.fit with the fused one-program step must produce the same
+    trained parameters as the unfused forward_backward+update path
+    (MXNET_FUSED_FIT=0)."""
+    import os
+    import numpy as np
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(3)
+    X = rng.uniform(-1, 1, (64, 10)).astype(np.float32)
+    w = rng.uniform(-1, 1, (10,)).astype(np.float32)
+    y = (X @ w > 0).astype(np.float32)
+
+    def build_and_fit():
+        it = mx.io.NDArrayIter(X, y, batch_size=16, shuffle=False,
+                               label_name="softmax_label")
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(net, context=mx.cpu())
+        mod.fit(it, num_epoch=3, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                                  "wd": 1e-4},
+                initializer=mx.initializer.Xavier(rnd_type="uniform",
+                                                  factor_type="avg",
+                                                  magnitude=2.0))
+        args, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in args.items()}
+
+    mx.random.seed(11)
+    fused = build_and_fit()
+    os.environ["MXNET_FUSED_FIT"] = "0"
+    try:
+        mx.random.seed(11)
+        unfused = build_and_fit()
+    finally:
+        del os.environ["MXNET_FUSED_FIT"]
+    assert set(fused) == set(unfused)
+    for k in fused:
+        np.testing.assert_allclose(fused[k], unfused[k], rtol=2e-4,
+                                   atol=2e-5, err_msg=k)
+
+
+def test_fused_fit_then_score_and_checkpoint(tmp_path):
+    """After fused fit, score() and save_checkpoint must see the trained
+    (threaded/donated) parameters."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(5)
+    X = rng.uniform(-1, 1, (128, 12)).astype(np.float32)
+    w = rng.uniform(-1, 1, (12,)).astype(np.float32)
+    y = (X @ w > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=False,
+                           label_name="softmax_label")
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=6, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.initializer.Xavier())
+    acc = dict(mod.score(it, mx.metric.create("acc")))["accuracy"]
+    assert acc > 0.9, acc
+    prefix = str(tmp_path / "fusedck")
+    mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+    mod2 = mx.mod.Module.load(prefix, 1)
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+              for_training=False)
+    acc2 = dict(mod2.score(it, mx.metric.create("acc")))["accuracy"]
+    np.testing.assert_allclose(acc2, acc, atol=1e-6)
+
+
+def test_set_params_after_fused_fit_takes_effect():
+    """set_params after fused training must win over the threaded fused
+    buffers (and not be clobbered by a later sync)."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(9)
+    X = rng.uniform(-1, 1, (32, 6)).astype(np.float32)
+    y = (rng.rand(32) > 0.5).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16, label_name="softmax_label")
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=2, name="fc1")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier())
+    frozen = {"fc1_weight": mx.nd.array(np.zeros((2, 6), np.float32)),
+              "fc1_bias": mx.nd.array(np.zeros((2,), np.float32))}
+    mod.set_params(frozen, {})
+    args, _ = mod.get_params()
+    np.testing.assert_array_equal(args["fc1_weight"].asnumpy(),
+                                  np.zeros((2, 6), np.float32))
+    # user-held arrays survive further training (no donation of aliases)
+    it.reset()
+    batch = next(iter(it))
+    mod.fit_step(batch)
+    _ = frozen["fc1_weight"].asnumpy()  # must not raise Array deleted
+    args, _ = mod.get_params()
+    assert np.abs(args["fc1_weight"].asnumpy()).max() > 0  # stepped from 0
+
+
+def test_reinit_optimizer_after_fused_fit():
+    """init_optimizer(force_init=True) mid-training must preserve the fused
+    (donated/threaded) parameter values."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(10)
+    X = rng.uniform(-1, 1, (32, 6)).astype(np.float32)
+    y = (rng.rand(32) > 0.5).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16, label_name="softmax_label")
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=2, name="fc1")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    batch = next(iter(it))
+    mod.fit_step(batch)
+    w_after = mod.get_params()[0]["fc1_weight"].asnumpy().copy()
+    mod.init_optimizer(kvstore=None, optimizer="adam", force_init=True)
+    np.testing.assert_array_equal(
+        mod.get_params()[0]["fc1_weight"].asnumpy(), w_after)
+    mod.fit_step(batch)  # must not raise Array deleted
+    assert np.abs(mod.get_params()[0]["fc1_weight"].asnumpy()
+                  - w_after).max() > 0

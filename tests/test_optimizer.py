@@ -147,3 +147,38 @@ def test_scheduler_in_optimizer():
         deltas.append(abs((cur - prev)[0]))
         prev = cur
     assert deltas[0] > deltas[-1]  # lr decayed
+
+
+def test_updater_update_all_matches_per_key():
+    """Batched whole-tree update (Updater.update_all, one jitted program)
+    must match the per-key eager path exactly for every optimizer with a
+    pure rule."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(7)
+    shapes = [(4, 3), (8,), (2, 2, 2)]
+    for name, kw in [("sgd", {"momentum": 0.9, "wd": 1e-3}),
+                     ("sgd", {}),
+                     ("nag", {"momentum": 0.9}),
+                     ("adam", {}),
+                     ("adagrad", {}),
+                     ("rmsprop", {}),
+                     ("rmsprop", {"centered": True}),
+                     ("adadelta", {})]:
+        opt_a = mx.optimizer.create(name, learning_rate=0.1, **kw)
+        opt_b = mx.optimizer.create(name, learning_rate=0.1, **kw)
+        up_a = mx.optimizer.get_updater(opt_a)
+        up_b = mx.optimizer.get_updater(opt_b)
+        ws_a = [mx.nd.array(rng.rand(*s).astype(np.float32)) for s in shapes]
+        ws_b = [mx.nd.array(w.asnumpy()) for w in ws_a]
+        for step in range(3):
+            gs = [mx.nd.array(rng.randn(*s).astype(np.float32)) for s in shapes]
+            for i, (w, g) in enumerate(zip(ws_a, gs)):
+                up_a(i, g, w)
+            up_b.update_all([(i, g, w) for i, (w, g)
+                             in enumerate(zip(ws_b, gs))])
+            for w_a, w_b in zip(ws_a, ws_b):
+                np.testing.assert_allclose(
+                    w_a.asnumpy(), w_b.asnumpy(), rtol=2e-5, atol=1e-6,
+                    err_msg="%s %s step %d" % (name, kw, step))
