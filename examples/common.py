@@ -1,0 +1,16 @@
+"""Shared example plumbing.
+
+respect_jax_platforms(): this machine's sitecustomize force-registers the
+axon PJRT plugin and resets jax_platforms, overriding the JAX_PLATFORMS
+env var. Pin the user's choice back (e.g. JAX_PLATFORMS=cpu with
+--xla_force_host_platform_device_count=N for a virtual mesh) so examples
+honor the documented env-var contract.
+"""
+import os
+
+
+def respect_jax_platforms():
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+        jax.config.update("jax_platforms", want)

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Inference throughput across the model zoo.
+
+TPU-native analogue of the reference's benchmark harness
+(example/image-classification/benchmark_score.py, the script behind every
+table in docs/how_to/perf.md / BASELINE.md): for each network and batch
+size, bind an inference executor, run warm + timed forward passes, print
+images/sec.
+
+Usage:
+    python examples/image-classification/benchmark_score.py \
+        [--networks alexnet,vgg16,inception-bn,inception-v3,resnet-50,resnet-152] \
+        [--batch-sizes 1,8,32] [--dtype bfloat16|float32] [--iters 50]
+
+Sync is a device->host readback (reliable even on tunneled devices).
+"""
+import argparse
+import sys
+import time
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+from common import respect_jax_platforms  # noqa: E402
+respect_jax_platforms()
+
+
+def score(network, batch, dtype, iters, dev):
+    import numpy as np
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+
+    sym = models.get_symbol(network, num_classes=1000)
+    shape = (batch, 3, 299, 299) if "v3" in network else (batch, 3, 224, 224)
+    exe = sym.simple_bind(dev, grad_req="null",
+                          compute_dtype=None if dtype == "float32" else dtype,
+                          data=shape, softmax_label=(batch,))
+    init = mx.initializer.Xavier(factor_type="in", magnitude=2.0)
+    for n, a in exe.arg_dict.items():
+        if n in ("data", "softmax_label"):
+            continue
+        init(mx.initializer.InitDesc(n), a)
+    rng = np.random.RandomState(0)
+    exe.arg_dict["data"]._data = jnp.asarray(
+        rng.uniform(-1, 1, shape).astype(np.float32))
+
+    def sync(outs):
+        return np.asarray(jnp.reshape(outs[0]._data, (-1,))[0])
+
+    for _ in range(3):
+        outs = exe.forward(is_train=False)
+    sync(outs)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        outs = exe.forward(is_train=False)
+    sync(outs)
+    return batch * iters / (time.perf_counter() - t0)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--networks", default="alexnet,vgg16,inception-bn,"
+                   "inception-v3,resnet-50,resnet-152")
+    p.add_argument("--batch-sizes", default="1,8,32")
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--iters", type=int, default=50)
+    args = p.parse_args()
+
+    import jax
+    import mxnet_tpu as mx
+    dev = (mx.Context("tpu", 0) if jax.default_backend() not in ("cpu",)
+           else mx.cpu())
+    for net in args.networks.split(","):
+        for b in (int(x) for x in args.batch_sizes.split(",")):
+            ips = score(net.strip(), b, args.dtype, args.iters, dev)
+            print("network: %-14s batch: %-3d images/sec: %.1f"
+                  % (net, b, ips), flush=True)
+
+
+if __name__ == "__main__":
+    main()

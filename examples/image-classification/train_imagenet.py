@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""ImageNet-class training (ResNet/Inception/VGG/AlexNet) with Module.fit.
+
+Analogue of the reference's example/image-classification/train_imagenet.py
+(the script behind BASELINE.md's training tables). Feeds ImageRecordIter
+when a RecordIO file is given (--data-train), else synthetic device-side
+data at full speed. bf16 compute is on by default (MXNET_COMPUTE_DTYPE).
+
+    python examples/image-classification/train_imagenet.py \
+        --network resnet-50 --batch-size 32 --num-batches 100
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+from common import respect_jax_platforms  # noqa: E402
+respect_jax_platforms()
+
+
+def main():
+    import logging
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--network", default="resnet-50")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--image-shape", default="3,224,224")
+    p.add_argument("--num-epochs", type=int, default=1)
+    p.add_argument("--num-batches", type=int, default=100,
+                   help="batches per epoch for synthetic data")
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--data-train", default=None, help=".rec file")
+    p.add_argument("--model-prefix", default=None)
+    p.add_argument("--dtype", default="bfloat16")
+    args = p.parse_args()
+
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+
+    if args.dtype != "float32":
+        os.environ.setdefault("MXNET_COMPUTE_DTYPE", args.dtype)
+
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    dev = (mx.Context("tpu", 0) if jax.default_backend() != "cpu"
+           else mx.cpu())
+
+    if args.data_train:
+        train = mx.io.ImageRecordIter(
+            path_imgrec=args.data_train, data_shape=shape,
+            batch_size=args.batch_size, shuffle=True, rand_mirror=True)
+    else:
+        rng = np.random.RandomState(0)
+        n = args.batch_size * args.num_batches
+        X = rng.uniform(-1, 1, (n,) + shape).astype(np.float32)
+        y = rng.randint(0, args.num_classes, n).astype(np.float32)
+        train = mx.io.NDArrayIter(X, y, batch_size=args.batch_size,
+                                  label_name="softmax_label")
+
+    sym = models.get_symbol(args.network, num_classes=args.num_classes)
+    mod = mx.mod.Module(sym, context=dev)
+    tic = time.time()
+    mod.fit(train, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-4},
+            initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                              factor_type="in",
+                                              magnitude=2.0),
+            batch_end_callback=[mx.callback.Speedometer(args.batch_size, 20)],
+            epoch_end_callback=([mx.callback.do_checkpoint(args.model_prefix)]
+                                if args.model_prefix else None),
+            kvstore=None)
+    dur = time.time() - tic
+    total = args.num_epochs * args.num_batches * args.batch_size
+    print("trained %d images in %.1fs (%.1f img/s incl. compile)"
+          % (total, dur, total / dur))
+
+
+if __name__ == "__main__":
+    main()

@@ -191,7 +191,14 @@ class Perplexity(EvalMetric):
             assert label.size == pred.size / pred.shape[-1]
             label = label.reshape(-1).astype(numpy.int64)
             pred = pred.reshape(-1, pred.shape[-1])
-            probs = pred[numpy.arange(label.size), label]
+            if self.ignore_label is not None:
+                # ignored/padded labels (e.g. -1) must not wrap-index a real
+                # class; their prob is overwritten below. With no
+                # ignore_label, out-of-range labels still raise (data bug).
+                label_idx = numpy.clip(label, 0, pred.shape[-1] - 1)
+            else:
+                label_idx = label
+            probs = pred[numpy.arange(label.size), label_idx]
             if self.ignore_label is not None:
                 ignore = (label == self.ignore_label).astype(pred.dtype)
                 num -= int(numpy.sum(ignore))

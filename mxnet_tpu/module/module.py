@@ -73,6 +73,7 @@ class Module(BaseModule):
         self._label_shapes = None
         self._fused_fit = None      # lazy fused fit-step state
         self._fused_dirty = False   # fused params newer than exec buffers
+        self._fused_refresh = False  # exec buffers newer than fused snapshot
         self._monitor_installed = False
 
     @staticmethod
@@ -141,10 +142,11 @@ class Module(BaseModule):
             return
         assert self.binded, "call bind before initializing the parameters"
         # a fused fit-step threads (donated) parameter buffers of its own;
-        # materialize them into the exec buffers, then drop the fused state
-        # so explicitly-set parameters take effect on the next step
+        # materialize them into the exec buffers, then mark the snapshot
+        # stale so explicitly-set parameters take effect on the next step
+        # (the compiled step program is kept — no per-epoch recompile)
         self._sync_fused_to_exec()
-        self._fused_fit = None
+        self._fused_refresh = True
 
         if self._arg_params is None:
             self._arg_params = {
@@ -244,6 +246,7 @@ class Module(BaseModule):
         self._label_shapes = None
         self._fused_fit = None
         self._fused_dirty = False
+        self._fused_refresh = False
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),), force_init=False):
@@ -313,16 +316,22 @@ class Module(BaseModule):
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
+        self._sync_fused_to_exec()
         self._exec_group.backward(out_grads=out_grads)
 
     def forward_backward(self, data_batch):
         """Fused path: one jitted XLA computation per step."""
         assert self.binded and self.params_initialized
+        self._sync_fused_to_exec()
         self._exec_group.forward_backward(data_batch)
 
     def update(self):
         """(reference module.py:553; model.py:88-110 update paths)."""
         assert self.binded and self.params_initialized and self.optimizer_initialized
+        # the manual path mutates exec/updater buffers directly: retire the
+        # fused snapshot (its compiled step is kept; fit_step re-snapshots)
+        self._sync_fused_to_exec()
+        self._fused_refresh = True
         self._params_dirty = True
         if self._update_on_kvstore:
             _update_params_on_kvstore(
@@ -385,6 +394,8 @@ class Module(BaseModule):
             self.forward_backward(data_batch)
             self.update()
             return
+        if self._fused_refresh:
+            self._refresh_fused_snapshot(fs)
         import numpy as _np
         import jax.numpy as _jnp
 
@@ -463,6 +474,31 @@ class Module(BaseModule):
         self._fused_fit = {"step": step, "params": params, "states": states,
                            "names": names, "idx_of": idx_of}
         return self._fused_fit
+
+    def _refresh_fused_snapshot(self, fs):
+        """Re-copy params/optimizer state from exec/updater buffers into the
+        fused snapshot (after set_params / a manual update), reusing the
+        already-compiled step program."""
+        import jax.numpy as _jnp
+
+        exec_ = self._exec_group._exec
+        for n in fs["names"]:
+            fs["params"][n] = _jnp.array(exec_.arg_dict[n]._data, copy=True)
+            i = fs["idx_of"][n]
+            if i not in self._updater.states:
+                self._updater.states[i] = self._optimizer.create_state(
+                    i, exec_.arg_dict[n])
+            st = self._updater.states[i]
+            if st is None:
+                fs["states"][n] = None
+            elif isinstance(st, tuple):
+                fs["states"][n] = tuple(
+                    None if x is None else _jnp.array(x._data, copy=True)
+                    for x in st)
+            else:
+                fs["states"][n] = _jnp.array(st._data, copy=True)
+        self._fused_refresh = False
+        self._fused_dirty = False
 
     def _sync_fused_to_exec(self):
         """Refresh executor arg buffers + updater state NDArrays from the

@@ -286,3 +286,55 @@ def test_reinit_optimizer_after_fused_fit():
     mod.fit_step(batch)  # must not raise Array deleted
     assert np.abs(mod.get_params()[0]["fc1_weight"].asnumpy()
                   - w_after).max() > 0
+
+
+def test_fused_and_manual_paths_interleave():
+    """fit_step -> manual forward_backward/update -> fit_step must agree
+    with the all-manual sequence (no stale fused snapshot), and the
+    compiled fused step must survive set_params (no per-epoch rebuild)."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(12)
+    X = rng.uniform(-1, 1, (16, 5)).astype(np.float32)
+    y = (rng.rand(16) > 0.5).astype(np.float32)
+
+    def build():
+        mx.random.seed(21)
+        it = mx.io.NDArrayIter(X, y, batch_size=16, label_name="softmax_label")
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=3, name="fc1")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(net, context=mx.cpu())
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(mx.initializer.Xavier())
+        mod.init_optimizer(kvstore=None, optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9})
+        return mod, next(iter(it))
+
+    mod_a, batch = build()
+    mod_a.fit_step(batch)
+    mod_a.forward_backward(batch)
+    mod_a.update()
+    mod_a.fit_step(batch)
+    w_mixed = mod_a.get_params()[0]["fc1_weight"].asnumpy()
+
+    import os
+    os.environ["MXNET_FUSED_FIT"] = "0"
+    try:
+        mod_b, batch_b = build()
+        for _ in range(3):
+            mod_b.forward_backward(batch_b)
+            mod_b.update()
+        w_manual = mod_b.get_params()[0]["fc1_weight"].asnumpy()
+    finally:
+        del os.environ["MXNET_FUSED_FIT"]
+    np.testing.assert_allclose(w_mixed, w_manual, rtol=2e-4, atol=2e-6)
+
+    # compiled fused state survives a set_params (epoch boundary)
+    fs_before = mod_a._fused_fit
+    args, auxs = mod_a.get_params()
+    mod_a.set_params(args, auxs)
+    mod_a.fit_step(batch)
+    assert mod_a._fused_fit is fs_before
