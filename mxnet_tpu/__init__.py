@@ -50,6 +50,7 @@ from . import log
 from . import libinfo
 from . import contrib
 from . import notebook
+from . import plugins
 
 
 def __getattr__(name):

@@ -1,0 +1,29 @@
+"""Optional foreign-kernel / foreign-data plugins.
+
+Capability parity with the reference's plugin/ tree (SURVEY §2.5):
+
+- ``plugins.opencv`` — cv2-like NDArray image API (plugin/opencv).
+- ``plugins.caffe``  — run Caffe layers as ops + CaffeNet data iterator
+  seam (plugin/caffe); gated on a caffe installation.
+- ``plugins.sframe`` — SFrame data iterator (plugin/sframe); gated on
+  turicreate/sframe.
+- The Torch plugin lives at :mod:`mxnet_tpu.torch` (reference
+  python/mxnet/torch.py location).
+
+All plugins share one extension seam: the Custom-op bridge
+(operator.py → jax.pure_callback) for foreign kernels, and the DataIter
+contract for foreign data sources — the TPU-native equivalent of the
+reference's "foreign-kernel as op" native plugins.
+"""
+from . import opencv
+
+__all__ = ["opencv", "caffe", "sframe"]
+
+
+def __getattr__(name):
+    if name in ("caffe", "sframe"):
+        import importlib
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

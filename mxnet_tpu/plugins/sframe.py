@@ -1,0 +1,59 @@
+"""SFrame data iterator.
+
+Capability parity with plugin/sframe (reference SURVEY §2.5: SFrameIter
+feeding SFrame/SArray columns as batches). Gated on turicreate (the
+maintained SFrame distribution); with plain pandas DataFrames use
+``mx.io.NDArrayIter`` directly.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..io import DataBatch, DataDesc, DataIter
+from .. import ndarray as nd
+
+
+class SFrameIter(DataIter):
+    """Iterate an SFrame: ``data_field`` columns stacked as the input,
+    optional ``label_field`` column as labels (plugin/sframe iter)."""
+
+    def __init__(self, sframe, data_field, label_field=None, batch_size=1,
+                 data_name="data", label_name="softmax_label"):
+        super().__init__()
+        if not (hasattr(sframe, "to_numpy") or hasattr(sframe, "select_columns")):
+            raise MXNetError("SFrameIter needs an SFrame-like object "
+                             "(turicreate.SFrame)")
+        fields = [data_field] if isinstance(data_field, str) else list(data_field)
+        cols = [np.asarray(list(sframe[f]), np.float32) for f in fields]
+        self._data = np.column_stack([c.reshape(len(c), -1) for c in cols])
+        self._label = (np.asarray(list(sframe[label_field]), np.float32)
+                       if label_field else None)
+        self.batch_size = batch_size
+        self.data_name = data_name
+        self.label_name = label_name
+        self._cursor = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self._data.shape[1:])]
+
+    @property
+    def provide_label(self):
+        if self._label is None:
+            return []
+        return [DataDesc(self.label_name, (self.batch_size,))]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        if self._cursor + self.batch_size > len(self._data):
+            raise StopIteration
+        i = self._cursor
+        self._cursor += self.batch_size
+        data = [nd.array(self._data[i:i + self.batch_size])]
+        label = ([nd.array(self._label[i:i + self.batch_size])]
+                 if self._label is not None else [])
+        return DataBatch(data=data, label=label, pad=0, index=None)

@@ -1,0 +1,69 @@
+"""Tests for the plugins/ parity surface (reference plugin/ tree, SURVEY
+§2.5) and the caffe prototxt converter (tools/caffe_converter)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_opencv_resize_and_border_no_cv2_needed():
+    img = mx.nd.array(np.arange(4 * 6 * 3, dtype=np.float32)
+                      .reshape(4, 6, 3))
+    out = mx.plugins.opencv.resize(img, (3, 2))
+    assert out.shape[0] == 2 and out.shape[1] == 3
+    padded = mx.plugins.opencv.copyMakeBorder(img, 1, 1, 2, 2, value=7)
+    assert padded.shape == (6, 10, 3)
+    assert padded.asnumpy()[0, 0, 0] == 7
+
+
+def test_opencv_jpeg_roundtrip_if_cv2():
+    cv2 = pytest.importorskip("cv2")
+    img = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype(np.uint8)
+    buf = mx.plugins.opencv.imencode(".png", img)
+    out = mx.plugins.opencv.imdecode(buf)  # png is lossless
+    np.testing.assert_array_equal(out.asnumpy(), img)
+
+
+def test_caffe_plugin_gated():
+    with pytest.raises(mx.MXNetError, match="caffe"):
+        mx.plugins.caffe.layer_op("type: \"ReLU\"", "co")
+
+
+def test_sframe_iter_rejects_non_sframe():
+    with pytest.raises(mx.MXNetError):
+        mx.plugins.sframe.SFrameIter({"a": [1, 2]}, "a")
+
+
+def test_caffe_converter_lenet(tmp_path):
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "cc", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+            "tools", "caffe_converter", "convert_symbol.py"))
+    cc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cc)
+    proto = '''
+name: "Tiny"
+input: "data"
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: AVE kernel_size: 2 stride: 2 } }
+layer { name: "ip" type: "InnerProduct" bottom: "pool1" top: "ip"
+  inner_product_param { num_output: 3 } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" top: "loss" }
+'''
+    sym, input_name = cc.convert(proto)
+    assert input_name == "data"
+    exe = sym.simple_bind(mx.cpu(), data=(2, 1, 8, 8), softmax_label=(2,))
+    init = mx.initializer.Xavier()
+    for n, a in exe.arg_dict.items():
+        if n in ("data", "softmax_label"):
+            continue
+        init(mx.initializer.InitDesc(n), a)
+    out = exe.forward(is_train=False)
+    assert out[0].shape == (2, 3)
+    np.testing.assert_allclose(out[0].asnumpy().sum(axis=1),
+                               np.ones(2), rtol=1e-5)

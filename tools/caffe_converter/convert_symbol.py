@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Convert a Caffe .prototxt network definition into a framework Symbol.
+
+Analogue of the reference's tools/caffe_converter (SURVEY §2.7): parses the
+protobuf *text format* directly (no caffe/protobuf schema needed) and maps
+the common layer types onto the op registry:
+
+Convolution, Pooling(MAX/AVE), InnerProduct, ReLU, Dropout, LRN, Concat,
+Eltwise(SUM), Flatten, BatchNorm(+Scale folded), Softmax/SoftmaxWithLoss.
+
+    python tools/caffe_converter/convert_symbol.py lenet.prototxt out.json
+"""
+import re
+import sys
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def parse_prototxt(text):
+    """Parse protobuf text format into nested dicts (repeated fields ->
+    lists)."""
+    tokens = re.findall(r'[\w.+-]+|"[^"]*"|[{}:]', text)
+    pos = 0
+
+    def parse_block():
+        nonlocal pos
+        out = {}
+        while pos < len(tokens) and tokens[pos] != "}":
+            key = tokens[pos]
+            pos += 1
+            if tokens[pos] == ":":
+                pos += 1
+                val = tokens[pos]
+                pos += 1
+                if val.startswith('"'):
+                    val = val[1:-1]
+                else:
+                    try:
+                        val = int(val)
+                    except ValueError:
+                        try:
+                            val = float(val)
+                        except ValueError:
+                            pass  # enum / bool string
+            elif tokens[pos] == "{":
+                pos += 1
+                val = parse_block()
+                assert tokens[pos] == "}"
+                pos += 1
+            else:
+                raise ValueError("parse error at %r" % tokens[pos:pos + 4])
+            if key in out:
+                if not isinstance(out[key], list):
+                    out[key] = [out[key]]
+                out[key].append(val)
+            else:
+                out[key] = val
+        return out
+
+    return parse_block()
+
+
+def _as_list(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+def convert(text):
+    """prototxt text -> (Symbol, input_name)."""
+    import mxnet_tpu as mx
+
+    net = parse_prototxt(text)
+    layers = _as_list(net.get("layer") or net.get("layers"))
+    blobs = {}
+    input_name = net.get("input", "data")
+    if isinstance(input_name, list):
+        input_name = input_name[0]
+    blobs[input_name] = mx.sym.Variable(input_name)
+
+    def get_bottom(l):
+        bots = _as_list(l.get("bottom", input_name))
+        return [blobs[b] for b in bots]
+
+    for l in layers:
+        ltype = str(l.get("type", "")).upper()
+        name = l.get("name", ltype.lower())
+        tops = _as_list(l.get("top", name))
+        if ltype in ("DATA", "INPUT", "HDF5DATA", "IMAGEDATA"):
+            for t in tops:
+                blobs[t] = blobs.get(input_name) or mx.sym.Variable(t)
+            continue
+        bot = get_bottom(l)
+        if ltype == "CONVOLUTION":
+            p = l.get("convolution_param", {})
+            k = int(p.get("kernel_size", 1))
+            out = mx.sym.Convolution(
+                bot[0], num_filter=int(p.get("num_output")),
+                kernel=(k, k),
+                stride=(int(p.get("stride", 1)),) * 2,
+                pad=(int(p.get("pad", 0)),) * 2,
+                num_group=int(p.get("group", 1)),
+                no_bias=str(p.get("bias_term", "true")).lower() == "false",
+                name=name)
+        elif ltype == "POOLING":
+            p = l.get("pooling_param", {})
+            k = int(p.get("kernel_size", 2))
+            pool = "max" if str(p.get("pool", "MAX")).upper() == "MAX" else "avg"
+            gp = str(p.get("global_pooling", "false")).lower() == "true"
+            out = mx.sym.Pooling(
+                bot[0], kernel=(k, k), pool_type=pool,
+                stride=(int(p.get("stride", 1)),) * 2,
+                pad=(int(p.get("pad", 0)),) * 2,
+                global_pool=gp, name=name)
+        elif ltype == "INNERPRODUCT":
+            p = l.get("inner_product_param", {})
+            out = mx.sym.FullyConnected(
+                mx.sym.Flatten(bot[0]),
+                num_hidden=int(p.get("num_output")), name=name)
+        elif ltype == "RELU":
+            out = mx.sym.Activation(bot[0], act_type="relu", name=name)
+        elif ltype == "SIGMOID":
+            out = mx.sym.Activation(bot[0], act_type="sigmoid", name=name)
+        elif ltype == "TANH":
+            out = mx.sym.Activation(bot[0], act_type="tanh", name=name)
+        elif ltype == "DROPOUT":
+            p = l.get("dropout_param", {})
+            out = mx.sym.Dropout(bot[0], p=float(p.get("dropout_ratio", 0.5)),
+                                 name=name)
+        elif ltype == "LRN":
+            p = l.get("lrn_param", {})
+            out = mx.sym.LRN(bot[0], nsize=int(p.get("local_size", 5)),
+                             alpha=float(p.get("alpha", 1e-4)),
+                             beta=float(p.get("beta", 0.75)), name=name)
+        elif ltype == "CONCAT":
+            out = mx.sym.Concat(*bot, name=name)
+        elif ltype == "ELTWISE":
+            out = bot[0]
+            for b in bot[1:]:
+                out = out + b
+        elif ltype == "FLATTEN":
+            out = mx.sym.Flatten(bot[0], name=name)
+        elif ltype == "BATCHNORM":
+            out = mx.sym.BatchNorm(bot[0], name=name)
+        elif ltype == "SCALE":
+            out = bot[0]  # folded into the preceding BatchNorm's gamma/beta
+        elif ltype in ("SOFTMAX", "SOFTMAXWITHLOSS"):
+            out = mx.sym.SoftmaxOutput(bot[0], name="softmax")
+        elif ltype == "ACCURACY":
+            continue
+        else:
+            raise NotImplementedError("caffe layer type %s" % ltype)
+        for t in tops:
+            blobs[t] = out
+
+    return out, input_name
+
+
+def main():
+    if len(sys.argv) < 2:
+        print(__doc__)
+        sys.exit(1)
+    text = open(sys.argv[1]).read()
+    sym, input_name = convert(text)
+    out_path = sys.argv[2] if len(sys.argv) > 2 else sys.argv[1] + ".json"
+    sym.save(out_path)
+    print("wrote %s (input: %s, args: %d)"
+          % (out_path, input_name, len(sym.list_arguments())))
+
+
+if __name__ == "__main__":
+    main()
