@@ -51,6 +51,7 @@ from . import libinfo
 from . import contrib
 from . import notebook
 from . import plugins
+from . import misc
 
 
 def __getattr__(name):

@@ -37,6 +37,7 @@ from __future__ import annotations
 import os
 import pickle
 import threading
+import time
 import queue
 from typing import Any, Dict, Optional
 
@@ -163,8 +164,17 @@ class KVStoreServer:
         while not self._stop.is_set():
             try:
                 conn = listener.accept()
-            except OSError:
-                break
+            except Exception:
+                # failed handshake (port probe, wrong authkey) or transient
+                # socket error must not kill the server — the reference
+                # server likewise survives bad peers (ps-lite van keeps
+                # accepting). Back off briefly so a persistently broken
+                # listener (EMFILE etc.) can't busy-spin a core; stop when
+                # the listener is closed on stop.
+                if self._stop.is_set():
+                    break
+                time.sleep(0.05)
+                continue
             threading.Thread(target=self._reader, args=(conn,),
                              daemon=True).start()
 

@@ -1,0 +1,42 @@
+"""Deprecated learning-rate scheduler interface.
+
+Capability parity with python/mxnet/misc.py (reference :7-56): the
+pre-`lr_scheduler.py` scheduler classes, kept for old user code. New code
+should use :mod:`mxnet_tpu.lr_scheduler`.
+"""
+from __future__ import annotations
+
+
+class LearningRateScheduler(object):
+    """Base class of the deprecated scheduler interface
+    (reference misc.py:7-23)."""
+
+    def __init__(self):
+        self.base_lr = 0.01
+
+    def __call__(self, iteration):
+        raise NotImplementedError("must override this")
+
+
+class FactorScheduler(LearningRateScheduler):
+    """Reduce lr by factor every ``step`` iterations
+    (reference misc.py:24-56)."""
+
+    def __init__(self, step, factor=0.1):
+        super().__init__()
+        if step < 1:
+            raise ValueError("Schedule step must be greater or equal than 1")
+        if factor >= 1.0:
+            raise ValueError("Factor must be less than 1 to make lr reduce")
+        self.step = step
+        self.factor = factor
+        self.old_lr = None
+
+    def __call__(self, iteration):
+        import logging
+        lr = self.base_lr * (self.factor ** (iteration // self.step))
+        if lr != self.old_lr:
+            self.old_lr = lr
+            logging.info("At Iteration [%d]: Swith to new learning rate %.5f",
+                         iteration, lr)
+        return lr

@@ -27,18 +27,35 @@ def _require_caffe():
             "Custom-op bridge")
 
 
-def layer_op(prototxt_str, op_name, num_weights=0):
+def layer_op(prototxt_str, op_name, input_shape=(1, 1, 1, 1),
+             out_shape_fn=None):
     """Register a Custom op that runs one Caffe layer defined by a
     LayerParameter prototxt string (reference plugin/caffe CaffeOp with
     its ``prototxt`` kwarg). Returns the registered op_type name.
+
+    input_shape: the shape declared to caffe for its internal net (the
+    actual runtime shape comes from each batch via blob reshape).
+    out_shape_fn: optional in_shape -> out_shape hook for layers that
+    change shape (conv, pooling); defaults to shape-preserving.
     """
     caffe = _require_caffe()
 
     class _CaffeOp(_operator.CustomOp):
         def __init__(self):
             super().__init__()
-            net_proto = ("input: \"data\"\n" + prototxt_str)
-            self._net = caffe.Net(net_proto, caffe.TEST)
+            import tempfile
+            # pycaffe's Net takes a file path, and the net needs explicit
+            # input dims in text format
+            net_proto = (
+                'input: "data"\n'
+                'input_shape { %s }\n%s'
+                % (" ".join("dim: %d" % d for d in input_shape),
+                   prototxt_str))
+            with tempfile.NamedTemporaryFile(
+                    "w", suffix=".prototxt", delete=False) as f:
+                f.write(net_proto)
+                path = f.name
+            self._net = caffe.Net(path, caffe.TEST)
 
         def forward(self, is_train, req, in_data, out_data, aux):
             self._net.blobs["data"].reshape(*in_data[0].shape)
@@ -60,10 +77,12 @@ def layer_op(prototxt_str, op_name, num_weights=0):
             super().__init__(need_top_grad=True)
 
         def list_arguments(self):
-            return ["data"] + ["weight_%d" % i for i in range(num_weights)]
+            return ["data"]
 
         def infer_shape(self, in_shape):
-            return in_shape, [in_shape[0]], []
+            out = (out_shape_fn(in_shape[0]) if out_shape_fn is not None
+                   else in_shape[0])
+            return in_shape, [list(out)], []
 
         def create_operator(self, ctx, shapes, dtypes):
             return _CaffeOp()

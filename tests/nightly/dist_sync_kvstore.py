@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Multi-process dist_sync kvstore validation with closed-form integer
+arithmetic — the analogue of the reference's nightly
+tests/nightly/dist_sync_kvstore.py (SURVEY §4.6), launched as REAL OS
+processes (one server + N workers), not threads.
+
+Each worker pushes (rank+1)-scaled ones; under the sync Test optimizer
+(weight += rescale * merged_grad) the value after R rounds must equal
+R * sum(rank+1 for all ranks) exactly. Includes a big (1200x1200) tensor
+mirroring the reference's server-sharding threshold case.
+
+Worker:  MXNET_TPU_ROLE=worker  MXNET_TPU_PS_URI=host:port \
+         MXNET_TPU_NUM_WORKERS=N MXNET_TPU_WORKER_RANK=r  python this.py
+Server:  MXNET_TPU_ROLE=server  MXNET_TPU_PS_URI=host:port \
+         MXNET_TPU_NUM_WORKERS=N  python this.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SHAPES = {3: (4, 4), 9: (1200, 1200)}  # small + big (sharding-bound case)
+ROUNDS = 3
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import kvstore_server
+
+    if kvstore_server.role() == "server":
+        kvstore_server.run()
+        return
+
+    rank = int(os.environ["MXNET_TPU_WORKER_RANK"])
+    n = int(os.environ["MXNET_TPU_NUM_WORKERS"])
+    kv = mx.kvstore.create("dist_sync")
+    assert kv.num_workers == n and kv.rank == rank
+    # every worker calls set_optimizer; only rank 0 ships it (the method
+    # barriers internally, matching Module.init_optimizer's collective use)
+    kv.set_optimizer(mx.optimizer.Test(rescale_grad=1.0))
+
+    for key, shape in SHAPES.items():
+        kv.init(key, mx.nd.zeros(shape))
+
+    expected_scale = sum(r + 1 for r in range(n))
+    for rnd in range(1, ROUNDS + 1):
+        for key, shape in SHAPES.items():
+            kv.push(key, mx.nd.ones(shape) * (rank + 1))
+        kv.barrier()
+        for key, shape in SHAPES.items():
+            out = mx.nd.zeros(shape)
+            kv.pull(key, out=out)
+            got = out.asnumpy()
+            want = np.full(shape, float(rnd * expected_scale), np.float32)
+            assert np.array_equal(got, want), (
+                "rank %d key %s round %d: got %s want %s"
+                % (rank, key, rnd, got.flat[:4], want.flat[:4]))
+        kv.barrier()
+
+    kv.barrier()
+    if rank == 0:
+        kv.stop_server()
+    print("worker %d OK (sync closed-form over %d rounds)" % (rank, ROUNDS))
+
+
+if __name__ == "__main__":
+    main()

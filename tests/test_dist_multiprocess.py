@@ -1,0 +1,77 @@
+"""REAL multi-process distributed integration test: one PS server process
++ 3 worker processes running tests/nightly/dist_sync_kvstore.py with
+closed-form expected values (reference nightly test_all.sh:37 runs
+`launch.py -n 4 dist_sync_kvstore.py`; SURVEY §4.6)."""
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "tests", "nightly", "dist_sync_kvstore.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_dist_sync_kvstore_multiprocess():
+    n_workers = 3
+    uri = "127.0.0.1:%d" % _free_port()
+    base = dict(os.environ,
+                JAX_PLATFORMS="cpu",
+                MXNET_TPU_PS_URI=uri,
+                MXNET_TPU_NUM_WORKERS=str(n_workers))
+
+    server = subprocess.Popen(
+        [sys.executable, SCRIPT],
+        env=dict(base, MXNET_TPU_ROLE="server"),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    # wait for the server socket (no fixed sleep: jax import can be slow)
+    host, port = uri.split(":")
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if server.poll() is not None:
+            out, _ = server.communicate()
+            raise AssertionError("server died at startup:\n%s" % out[-3000:])
+        try:
+            socket.create_connection((host, int(port)), timeout=1).close()
+            break
+        except OSError:
+            time.sleep(0.3)
+    else:
+        raise AssertionError("server never bound %s" % uri)
+    workers = [
+        subprocess.Popen(
+            [sys.executable, SCRIPT],
+            env=dict(base, MXNET_TPU_ROLE="worker",
+                     MXNET_TPU_WORKER_RANK=str(r)),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(n_workers)
+    ]
+    try:
+        # fail fast: if ANY worker exits non-zero, report it instead of
+        # hanging the rest at the server barrier until timeouts expire
+        deadline = time.time() + 300
+        pending = dict(enumerate(workers))
+        while pending and time.time() < deadline:
+            for r, w in list(pending.items()):
+                if w.poll() is not None:
+                    out, _ = w.communicate()
+                    assert w.returncode == 0, (
+                        "worker %d failed:\n%s" % (r, out[-3000:]))
+                    assert "OK" in out
+                    del pending[r]
+            time.sleep(0.2)
+        assert not pending, "workers %s hung" % sorted(pending)
+        out, _ = server.communicate(timeout=60)
+        assert server.returncode == 0, "server failed:\n%s" % out[-3000:]
+    finally:
+        for p in workers + [server]:
+            if p.poll() is None:
+                p.kill()

@@ -21,6 +21,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 def parse_prototxt(text):
     """Parse protobuf text format into nested dicts (repeated fields ->
     lists)."""
+    text = re.sub(r"#[^\n]*", "", text)  # strip comments
     tokens = re.findall(r'[\w.+-]+|"[^"]*"|[{}:]', text)
     pos = 0
 
@@ -62,6 +63,28 @@ def parse_prototxt(text):
     return parse_block()
 
 
+def _first(v):
+    """First element of a possibly-repeated scalar field."""
+    return v[0] if isinstance(v, list) else v
+
+
+def _kernel_hw(p, default):
+    """kernel size as (h, w): kernel_size (possibly repeated) or
+    kernel_h/kernel_w, as Caffe allows."""
+    if "kernel_h" in p or "kernel_w" in p:
+        return int(p.get("kernel_h", default)), int(p.get("kernel_w", default))
+    k = _first(p.get("kernel_size", default))
+    return int(k), int(k)
+
+
+def _pair(p, field, default):
+    if field + "_h" in p or field + "_w" in p:
+        return (int(p.get(field + "_h", default)),
+                int(p.get(field + "_w", default)))
+    v = int(_first(p.get(field, default)))
+    return (v, v)
+
+
 def _as_list(v):
     if v is None:
         return []
@@ -95,24 +118,24 @@ def convert(text):
         bot = get_bottom(l)
         if ltype == "CONVOLUTION":
             p = l.get("convolution_param", {})
-            k = int(p.get("kernel_size", 1))
+            kh, kw = _kernel_hw(p, 1)
             out = mx.sym.Convolution(
                 bot[0], num_filter=int(p.get("num_output")),
-                kernel=(k, k),
-                stride=(int(p.get("stride", 1)),) * 2,
-                pad=(int(p.get("pad", 0)),) * 2,
+                kernel=(kh, kw),
+                stride=_pair(p, "stride", 1),
+                pad=_pair(p, "pad", 0),
                 num_group=int(p.get("group", 1)),
                 no_bias=str(p.get("bias_term", "true")).lower() == "false",
                 name=name)
         elif ltype == "POOLING":
             p = l.get("pooling_param", {})
-            k = int(p.get("kernel_size", 2))
+            kh, kw = _kernel_hw(p, 2)
             pool = "max" if str(p.get("pool", "MAX")).upper() == "MAX" else "avg"
             gp = str(p.get("global_pooling", "false")).lower() == "true"
             out = mx.sym.Pooling(
-                bot[0], kernel=(k, k), pool_type=pool,
-                stride=(int(p.get("stride", 1)),) * 2,
-                pad=(int(p.get("pad", 0)),) * 2,
+                bot[0], kernel=(kh, kw), pool_type=pool,
+                stride=_pair(p, "stride", 1),
+                pad=_pair(p, "pad", 0),
                 global_pool=gp, name=name)
         elif ltype == "INNERPRODUCT":
             p = l.get("inner_product_param", {})
@@ -137,9 +160,17 @@ def convert(text):
         elif ltype == "CONCAT":
             out = mx.sym.Concat(*bot, name=name)
         elif ltype == "ELTWISE":
+            op = str(l.get("eltwise_param", {}).get("operation", "SUM")).upper()
             out = bot[0]
             for b in bot[1:]:
-                out = out + b
+                if op == "SUM":
+                    out = out + b
+                elif op == "PROD":
+                    out = out * b
+                elif op == "MAX":
+                    out = mx.sym.maximum(out, b)
+                else:
+                    raise NotImplementedError("eltwise operation %s" % op)
         elif ltype == "FLATTEN":
             out = mx.sym.Flatten(bot[0], name=name)
         elif ltype == "BATCHNORM":
