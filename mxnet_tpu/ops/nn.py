@@ -552,7 +552,8 @@ def _make_loss(attrs, data):
         return d
 
     def op_fwd(d):
-        return d, d.shape[0]
+        # batch size for normalization="batch"; scalar losses have none
+        return d, (d.shape[0] if d.ndim else 1)
 
     def op_bwd(batch, g):
         scale = attrs["grad_scale"]

@@ -10,6 +10,8 @@ plugin is likewise opt-in via CAFFE_PATH, make/config.mk).
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ..base import MXNetError
@@ -48,14 +50,18 @@ def layer_op(prototxt_str, op_name, input_shape=(1, 1, 1, 1),
             # input dims in text format
             net_proto = (
                 'input: "data"\n'
-                'input_shape { %s }\n%s'
+                'input_shape { %s }\n'
+                'force_backward: true\n%s'   # else Net computes no diffs
                 % (" ".join("dim: %d" % d for d in input_shape),
                    prototxt_str))
             with tempfile.NamedTemporaryFile(
                     "w", suffix=".prototxt", delete=False) as f:
                 f.write(net_proto)
                 path = f.name
-            self._net = caffe.Net(path, caffe.TEST)
+            try:
+                self._net = caffe.Net(path, caffe.TEST)
+            finally:
+                os.unlink(path)
 
         def forward(self, is_train, req, in_data, out_data, aux):
             self._net.blobs["data"].reshape(*in_data[0].shape)

@@ -31,12 +31,17 @@ def main():
     args = ap.parse_args()
 
     import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        # honor the env var even where sitecustomize force-registers a
+        # different default platform
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import numpy as np
     import jax.numpy as jnp
     from jax.sharding import Mesh
     from mxnet_tpu.parallel import collectives
 
     devs = jax.devices()
-    mesh = Mesh(jax.numpy.array(devs).reshape(len(devs)), (args.axis,))
+    mesh = Mesh(np.array(devs).reshape(len(devs)), (args.axis,))
     rows = []
     for mb in args.sizes_mb:
         gbps = collectives.bus_bandwidth(mesh, args.axis, size_mb=mb,

@@ -21,7 +21,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 def parse_prototxt(text):
     """Parse protobuf text format into nested dicts (repeated fields ->
     lists)."""
-    text = re.sub(r"#[^\n]*", "", text)  # strip comments
+    # strip comments, but not '#' inside quoted strings
+    text = re.sub(r'("[^"]*")|#[^\n]*',
+                  lambda m: m.group(1) or "", text)
     tokens = re.findall(r'[\w.+-]+|"[^"]*"|[{}:]', text)
     pos = 0
 
@@ -63,26 +65,32 @@ def parse_prototxt(text):
     return parse_block()
 
 
-def _first(v):
-    """First element of a possibly-repeated scalar field."""
-    return v[0] if isinstance(v, list) else v
+def _hw(v, default):
+    """(h, w) from a scalar or per-axis repeated field: 'kernel_size: 3'
+    -> (3, 3); 'kernel_size: 3 kernel_size: 5' -> (3, 5)."""
+    if v is None:
+        return (int(default), int(default))
+    if isinstance(v, list):
+        if len(v) != 2:
+            raise NotImplementedError(
+                "repeated spatial field with %d entries" % len(v))
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
 
 
 def _kernel_hw(p, default):
-    """kernel size as (h, w): kernel_size (possibly repeated) or
+    """kernel size as (h, w): kernel_size (scalar or per-axis repeated) or
     kernel_h/kernel_w, as Caffe allows."""
     if "kernel_h" in p or "kernel_w" in p:
         return int(p.get("kernel_h", default)), int(p.get("kernel_w", default))
-    k = _first(p.get("kernel_size", default))
-    return int(k), int(k)
+    return _hw(p.get("kernel_size"), default)
 
 
 def _pair(p, field, default):
     if field + "_h" in p or field + "_w" in p:
         return (int(p.get(field + "_h", default)),
                 int(p.get(field + "_w", default)))
-    v = int(_first(p.get(field, default)))
-    return (v, v)
+    return _hw(p.get(field), default)
 
 
 def _as_list(v):
@@ -160,7 +168,11 @@ def convert(text):
         elif ltype == "CONCAT":
             out = mx.sym.Concat(*bot, name=name)
         elif ltype == "ELTWISE":
-            op = str(l.get("eltwise_param", {}).get("operation", "SUM")).upper()
+            ep = l.get("eltwise_param", {})
+            op = str(ep.get("operation", "SUM")).upper()
+            if "coeff" in ep:
+                raise NotImplementedError(
+                    "eltwise coeff weights are not supported")
             out = bot[0]
             for b in bot[1:]:
                 if op == "SUM":
