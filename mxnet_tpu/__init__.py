@@ -2,6 +2,8 @@
 MXNet 0.9.x (NNVM era), built on JAX/XLA idioms rather than ported from the
 reference's CUDA/C++ engine. See SURVEY.md for the architectural map.
 """
+import os as _os
+
 from . import base
 from .base import MXNetError, __version__
 from .context import Context, cpu, cpu_pinned, gpu, tpu, current_context, num_devices
@@ -10,51 +12,77 @@ from . import ndarray as nd
 from . import symbol
 from . import symbol as sym
 from .symbol import Variable, Group
-from . import autograd
-from . import random
-from .random import seed
+
+# Predict-only builds (reference amalgamation MXNET_PREDICT_ONLY,
+# include/mxnet/base.h:72-74): bind only the deployment surface — arrays,
+# symbols, executor, predictor (plus their transitive deps like random/
+# autograd) — and leave the training-stack names unbound. Direct
+# `import mxnet_tpu.module` still works, as reference amalgamation users
+# could still link the full library; the flag shapes the default surface.
+_PREDICT_ONLY = _os.environ.get("MXNET_PREDICT_ONLY", "") not in ("", "0")
+
 from . import executor
 from .executor import Executor
-from .attribute import AttrScope
-from .name import NameManager, Prefix
-from . import initializer
-from .initializer import init_registry  # noqa: F401
-from . import optimizer
-from . import metric
-from . import lr_scheduler
-from . import callback
-from . import io
-from . import kvstore
-from . import module as mod
-from . import module
-from . import monitor
-from .monitor import Monitor
-from . import visualization
-from . import visualization as viz
-from . import test_utils
-from . import model
-from .model import FeedForward
-from . import executor_manager
-from . import kvstore_server
-from . import operator
-from . import models
-from . import recordio
-from . import rtc
 from . import predict
-from . import engine
-from . import rnn
-from . import profiler
-from . import image
-from . import registry
-from . import log
-from . import libinfo
-from . import contrib
-from . import notebook
-from . import plugins
-from . import misc
+from . import autograd   # transitive deps of the executor surface:
+from . import random     # bound unconditionally for consistency
+from .random import seed
+
+_TRAINING_SURFACE = frozenset((
+    "AttrScope", "NameManager", "Prefix", "initializer", "init_registry",
+    "optimizer", "metric", "lr_scheduler", "callback", "io", "kvstore",
+    "mod", "module", "monitor", "Monitor", "visualization", "viz",
+    "test_utils", "model", "FeedForward", "executor_manager",
+    "kvstore_server", "operator", "models", "recordio", "rtc", "engine",
+    "rnn", "profiler", "image", "registry", "log", "libinfo", "contrib",
+    "notebook", "plugins", "misc", "torch", "th",
+))
+
+if not _PREDICT_ONLY:
+    from .attribute import AttrScope
+    from .name import NameManager, Prefix
+    from . import initializer
+    from .initializer import init_registry  # noqa: F401
+    from . import optimizer
+    from . import metric
+    from . import lr_scheduler
+    from . import callback
+    from . import io
+    from . import kvstore
+    from . import module as mod
+    from . import module
+    from . import monitor
+    from .monitor import Monitor
+    from . import visualization
+    from . import visualization as viz
+    from . import test_utils
+    from . import model
+    from .model import FeedForward
+    from . import executor_manager
+    from . import kvstore_server
+    from . import operator
+    from . import models
+    from . import recordio
+    from . import rtc
+    from . import engine
+    from . import rnn
+    from . import profiler
+    from . import image
+    from . import registry
+    from . import log
+    from . import libinfo
+    from . import contrib
+    from . import notebook
+    from . import plugins
+    from . import misc
 
 
 def __getattr__(name):
+    if _PREDICT_ONLY and name in _TRAINING_SURFACE:
+        raise AttributeError(
+            "mxnet_tpu was imported with MXNET_PREDICT_ONLY=1; %r is "
+            "outside the predict-only surface (unset the env var, or "
+            "import the submodule explicitly)" % name)
     # Lazy heavy/optional plugins: mx.torch (PyTorch foreign-kernel seam,
     # torch.py) is only imported on first touch, like the reference's
     # opt-in Torch plugin (plugin/torch, make/config.mk TORCH_PATH).
