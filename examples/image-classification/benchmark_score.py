@@ -53,11 +53,15 @@ def score(network, batch, dtype, iters, dev):
     for _ in range(3):
         outs = exe.forward(is_train=False)
     sync(outs)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        outs = exe.forward(is_train=False)
-    sync(outs)
-    return batch * iters / (time.perf_counter() - t0)
+    best = None
+    for _ in range(int(os.environ.get("BENCH_REPEATS", "3"))):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            outs = exe.forward(is_train=False)
+        sync(outs)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return batch * iters / best
 
 
 def main():
