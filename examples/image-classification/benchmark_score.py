@@ -54,7 +54,7 @@ def score(network, batch, dtype, iters, dev):
         outs = exe.forward(is_train=False)
     sync(outs)
     best = None
-    for _ in range(int(os.environ.get("BENCH_REPEATS", "3"))):
+    for _ in range(max(1, int(float(os.environ.get("BENCH_REPEATS", "3"))))):
         t0 = time.perf_counter()
         for _ in range(iters):
             outs = exe.forward(is_train=False)
