@@ -12,8 +12,11 @@ import logging
 
 import numpy as np
 
+import jax.numpy as jnp
+
 from .. import ndarray as nd
 from .. import optimizer as opt
+from ..optimizer import state_leaves, write_state_leaves
 from ..base import MXNetError
 from ..context import Context, cpu
 from ..initializer import InitDesc, Uniform
@@ -396,21 +399,22 @@ class Module(BaseModule):
             return
         if self._fused_refresh:
             self._refresh_fused_snapshot(fs)
-        import numpy as _np
-        import jax.numpy as _jnp
-
         opt_ = self._optimizer
         idx_of = fs["idx_of"]
         for n in fs["names"]:
             opt_._update_count(idx_of[n])
-        lw = _np.array([opt_.effective_lr_wd(idx_of[n]) for n in fs["names"]],
-                       _np.float32)
+        lw = np.array([opt_.effective_lr_wd(idx_of[n]) for n in fs["names"]],
+                      np.float32)
+        # lr/wd arrays cached across steps (constant-lr: no re-upload)
+        if fs.get("lw") is None or not np.array_equal(fs["lw"], lw):
+            fs["lw"] = lw
+            fs["lr_arr"] = jnp.asarray(lw[:, 0])
+            fs["wd_arr"] = jnp.asarray(lw[:, 1])
         # place the batch with the group's device/sharding logic; the step
         # then reads the executor's data buffers (empty feed dict).
         self._exec_group._load_data(data_batch)
         _, fs["params"], fs["states"] = fs["step"](
-            fs["params"], fs["states"], {},
-            _jnp.asarray(lw[:, 0]), _jnp.asarray(lw[:, 1]))
+            fs["params"], fs["states"], {}, fs["lr_arr"], fs["wd_arr"])
         self._params_dirty = True
         self._fused_dirty = True
 
@@ -449,12 +453,10 @@ class Module(BaseModule):
                                           lr_arr[pos], wd_arr[pos])
             return new_p, new_s
 
-        import jax.numpy as _jnp
-
         step = exec_.make_train_step(update_fn)
         # device-side copies: the step donates these, and donation must not
         # delete buffers aliased by exec arg_dict / user-held NDArrays
-        params = {n: _jnp.array(exec_.arg_dict[n]._data, copy=True)
+        params = {n: jnp.array(exec_.arg_dict[n]._data, copy=True)
                   for n in names}
         states = {}
         for n in names:
@@ -462,41 +464,23 @@ class Module(BaseModule):
             if i not in self._updater.states:
                 self._updater.states[i] = self._optimizer.create_state(
                     i, exec_.arg_dict[n])
-            st = self._updater.states[i]
-            if st is None:
-                states[n] = None
-            elif isinstance(st, tuple):
-                states[n] = tuple(
-                    None if x is None else _jnp.array(x._data, copy=True)
-                    for x in st)
-            else:
-                states[n] = _jnp.array(st._data, copy=True)
+            states[n] = state_leaves(self._updater.states[i], copy=True)
         self._fused_fit = {"step": step, "params": params, "states": states,
-                           "names": names, "idx_of": idx_of}
+                           "names": names, "idx_of": idx_of, "lw": None}
         return self._fused_fit
 
     def _refresh_fused_snapshot(self, fs):
         """Re-copy params/optimizer state from exec/updater buffers into the
         fused snapshot (after set_params / a manual update), reusing the
         already-compiled step program."""
-        import jax.numpy as _jnp
-
         exec_ = self._exec_group._exec
         for n in fs["names"]:
-            fs["params"][n] = _jnp.array(exec_.arg_dict[n]._data, copy=True)
+            fs["params"][n] = jnp.array(exec_.arg_dict[n]._data, copy=True)
             i = fs["idx_of"][n]
             if i not in self._updater.states:
                 self._updater.states[i] = self._optimizer.create_state(
                     i, exec_.arg_dict[n])
-            st = self._updater.states[i]
-            if st is None:
-                fs["states"][n] = None
-            elif isinstance(st, tuple):
-                fs["states"][n] = tuple(
-                    None if x is None else _jnp.array(x._data, copy=True)
-                    for x in st)
-            else:
-                fs["states"][n] = _jnp.array(st._data, copy=True)
+            fs["states"][n] = state_leaves(self._updater.states[i], copy=True)
         self._fused_refresh = False
         self._fused_dirty = False
 
@@ -509,16 +493,8 @@ class Module(BaseModule):
         exec_ = self._exec_group._exec
         for n in fs["names"]:
             exec_.arg_dict[n]._data = fs["params"][n]
-            st = self._updater.states.get(fs["idx_of"][n])
-            leaf = fs["states"][n]
-            if st is None:
-                continue
-            if isinstance(st, tuple):
-                for old, val in zip(st, leaf):
-                    if old is not None:
-                        old._data = val
-            else:
-                st._data = leaf
+            write_state_leaves(self._updater.states.get(fs["idx_of"][n]),
+                               fs["states"][n])
         self._fused_dirty = False
 
     def install_monitor(self, mon):

@@ -34,6 +34,36 @@ def register(klass):
 
 
 
+def state_leaves(state, copy=False):
+    """Raw jax leaves of an optimizer state (None / NDArray / tuple of
+    NDArrays) — shared by the batched updater and Module's fused fit step."""
+    import jax.numpy as jnp
+
+    def leaf(x):
+        if x is None:
+            return None
+        return jnp.array(x._data, copy=True) if copy else x._data
+
+    if state is None:
+        return None
+    if isinstance(state, tuple):
+        return tuple(leaf(x) for x in state)
+    return leaf(state)
+
+
+def write_state_leaves(state, leaves):
+    """Write raw leaves back into the state's NDArrays (inverse of
+    state_leaves)."""
+    if state is None:
+        return
+    if isinstance(state, tuple):
+        for old, val in zip(state, leaves):
+            if old is not None:
+                old._data = val
+    else:
+        state._data = leaves
+
+
 def _zeros_like_state(weight):
     """State buffer matching the weight's dtype AND (mesh) sharding, so fused
     updates run where the weight lives."""
@@ -572,23 +602,19 @@ class Updater:
                 self.states[index] = opt.create_state(index, weight)
             opt._update_count(index)
 
-        def to_leaves(state):
-            if state is None:
-                return None
-            if isinstance(state, tuple):
-                return tuple(x if x is None else x._data for x in state)
-            return state._data
-
         keys = tuple(sorted(p[0] for p in pairs))
         by_idx = {p[0]: p for p in pairs}
         weights = {str(i): by_idx[i][2]._data for i in keys}
         grads = {str(i): by_idx[i][1]._data for i in keys}
-        states = {str(i): to_leaves(self.states[i]) for i in keys}
+        states = {str(i): state_leaves(self.states[i]) for i in keys}
         # lr/wd ship as TWO stacked arrays (one h2d transfer each), not
         # hundreds of scalar buffers; indexed inside the jitted program.
+        # Cached across steps: constant-lr training re-uploads nothing.
         lw = np.array([opt.effective_lr_wd(i) for i in keys], np.float32)
-        lr_arr = jnp.asarray(lw[:, 0])
-        wd_arr = jnp.asarray(lw[:, 1])
+        cached = getattr(self, "_lw_cache", None)
+        if cached is None or not np.array_equal(cached[0], lw):
+            self._lw_cache = (lw, jnp.asarray(lw[:, 0]), jnp.asarray(lw[:, 1]))
+        lr_arr, wd_arr = self._lw_cache[1], self._lw_cache[2]
 
         if (self._tree_fn is None or self._tree_keys != keys
                 or getattr(self, "_tree_hyper", None) !=
@@ -613,15 +639,7 @@ class Updater:
         for i in keys:
             k = str(i)
             by_idx[i][2]._data = new_w[k]
-            st, new = self.states[i], new_s[k]
-            if st is None:
-                continue
-            if isinstance(st, tuple):
-                for old, val in zip(st, new):
-                    if old is not None:
-                        old._data = val
-            else:
-                st._data = new
+            write_state_leaves(self.states[i], new_s[k])
 
     def set_states(self, states):
         blob = pickle.loads(states)
