@@ -19,7 +19,7 @@ from .module import Module
 class BucketingModule(BaseModule):
     def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
-                 state_names=None):
+                 state_names=None, compute_dtype=None):
         super().__init__(logger=logger)
         assert default_bucket_key is not None
         self._default_bucket_key = default_bucket_key
@@ -28,6 +28,7 @@ class BucketingModule(BaseModule):
         self._work_load_list = work_load_list
         self._fixed_param_names = fixed_param_names
         self._state_names = state_names
+        self._compute_dtype = compute_dtype
         self._buckets = {}
         self._curr_module = None
         self._curr_bucket_key = None
@@ -120,6 +121,7 @@ class BucketingModule(BaseModule):
 
         symbol, data_names, label_names = self._call_sym_gen(self._default_bucket_key)
         module = Module(symbol, data_names, label_names, logger=self.logger,
+                        compute_dtype=self._compute_dtype,
                         context=self._context, work_load_list=self._work_load_list,
                         fixed_param_names=self._fixed_param_names,
                         state_names=self._state_names)
@@ -136,6 +138,7 @@ class BucketingModule(BaseModule):
         if bucket_key not in self._buckets:
             symbol, data_names, label_names = self._call_sym_gen(bucket_key)
             module = Module(symbol, data_names, label_names, logger=self.logger,
+                        compute_dtype=self._compute_dtype,
                             context=self._context,
                             work_load_list=self._work_load_list,
                             fixed_param_names=self._fixed_param_names,

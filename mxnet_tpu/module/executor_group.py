@@ -34,8 +34,9 @@ class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad, shared_group=None,
                  logger=None, fixed_param_names=None, grad_req="write",
-                 state_names=None):
+                 state_names=None, compute_dtype=None):
         self.symbol = symbol
+        self.compute_dtype = compute_dtype
         self.contexts = contexts
         self.param_names = param_names
         self.for_training = for_training
@@ -117,7 +118,8 @@ class DataParallelExecutorGroup:
         from ..executor import Executor
 
         self._exec = Executor(self.symbol, ctx0, args, grads or None, self.grad_req,
-                              auxs, shared_exec=shared_exec)
+                              auxs, shared_exec=shared_exec,
+                              compute_dtype=self.compute_dtype)
         self.execs = [self._exec]  # reference-compat attribute
 
     def _alloc(self, shape, replicated=True):

@@ -16,7 +16,8 @@ import jax.numpy as jnp
 
 from .. import ndarray as nd
 from .. import optimizer as opt
-from ..optimizer import state_leaves, write_state_leaves
+from ..optimizer import (cached_lr_wd_arrays, state_leaves,
+                         write_state_leaves)
 from ..base import MXNetError
 from ..context import Context, cpu
 from ..initializer import InitDesc, Uniform
@@ -32,7 +33,11 @@ from .executor_group import DataParallelExecutorGroup
 class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
                  logger=logging, context=None, work_load_list=None,
-                 fixed_param_names=None, state_names=None):
+                 fixed_param_names=None, state_names=None,
+                 compute_dtype=None):
+        """compute_dtype: mixed-precision compute dtype for the bound
+        executors ("bfloat16"; master weights stay fp32) — the Module-level
+        surface of Executor's compute_dtype / MXNET_COMPUTE_DTYPE."""
         super().__init__(logger=logger)
         if context is None:
             context = [cpu()]
@@ -40,6 +45,7 @@ class Module(BaseModule):
             context = [context]
         self._context = context
         self._work_load_list = work_load_list
+        self._compute_dtype = compute_dtype
 
         self._symbol = symbol
         data_names = list(data_names) if data_names is not None else []
@@ -233,7 +239,7 @@ class Module(BaseModule):
             self._label_shapes, self._param_names, for_training, inputs_need_grad,
             shared_group, logger=self.logger,
             fixed_param_names=self._fixed_param_names, grad_req=grad_req,
-            state_names=self._state_names,
+            state_names=self._state_names, compute_dtype=self._compute_dtype,
         )
         if shared_module is not None:
             self.params_initialized = True
@@ -406,15 +412,12 @@ class Module(BaseModule):
         lw = np.array([opt_.effective_lr_wd(idx_of[n]) for n in fs["names"]],
                       np.float32)
         # lr/wd arrays cached across steps (constant-lr: no re-upload)
-        if fs.get("lw") is None or not np.array_equal(fs["lw"], lw):
-            fs["lw"] = lw
-            fs["lr_arr"] = jnp.asarray(lw[:, 0])
-            fs["wd_arr"] = jnp.asarray(lw[:, 1])
+        lr_arr, wd_arr, fs["lw"] = cached_lr_wd_arrays(fs.get("lw"), lw)
         # place the batch with the group's device/sharding logic; the step
         # then reads the executor's data buffers (empty feed dict).
         self._exec_group._load_data(data_batch)
         _, fs["params"], fs["states"] = fs["step"](
-            fs["params"], fs["states"], {}, fs["lr_arr"], fs["wd_arr"])
+            fs["params"], fs["states"], {}, lr_arr, wd_arr)
         self._params_dirty = True
         self._fused_dirty = True
 
@@ -466,7 +469,7 @@ class Module(BaseModule):
                     i, exec_.arg_dict[n])
             states[n] = state_leaves(self._updater.states[i], copy=True)
         self._fused_fit = {"step": step, "params": params, "states": states,
-                           "names": names, "idx_of": idx_of, "lw": None}
+                           "names": names, "idx_of": idx_of}
         return self._fused_fit
 
     def _refresh_fused_snapshot(self, fs):

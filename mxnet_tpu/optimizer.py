@@ -34,6 +34,17 @@ def register(klass):
 
 
 
+def cached_lr_wd_arrays(cache, lw):
+    """(lr_arr, wd_arr, new_cache): re-upload the stacked lr/wd arrays only
+    when the host-side values changed — shared by Updater.update_all and
+    Module's fused fit step."""
+    import jax.numpy as jnp
+
+    if cache is None or not np.array_equal(cache[0], lw):
+        cache = (lw, jnp.asarray(lw[:, 0]), jnp.asarray(lw[:, 1]))
+    return cache[1], cache[2], cache
+
+
 def state_leaves(state, copy=False):
     """Raw jax leaves of an optimizer state (None / NDArray / tuple of
     NDArrays) — shared by the batched updater and Module's fused fit step."""
@@ -574,6 +585,7 @@ class Updater:
         self.states = {}
         self._tree_fn = None
         self._tree_keys = None
+        self._lw_cache = None
 
     def __call__(self, index, grad, weight):
         if index not in self.states:
@@ -611,10 +623,8 @@ class Updater:
         # hundreds of scalar buffers; indexed inside the jitted program.
         # Cached across steps: constant-lr training re-uploads nothing.
         lw = np.array([opt.effective_lr_wd(i) for i in keys], np.float32)
-        cached = getattr(self, "_lw_cache", None)
-        if cached is None or not np.array_equal(cached[0], lw):
-            self._lw_cache = (lw, jnp.asarray(lw[:, 0]), jnp.asarray(lw[:, 1]))
-        lr_arr, wd_arr = self._lw_cache[1], self._lw_cache[2]
+        lr_arr, wd_arr, self._lw_cache = cached_lr_wd_arrays(
+            self._lw_cache, lw)
 
         if (self._tree_fn is None or self._tree_keys != keys
                 or getattr(self, "_tree_hyper", None) !=
