@@ -426,3 +426,118 @@ def test_makeloss_and_svm():
     lab = np.array([0, 2, 1], np.float32)
     out = nd.SVMOutput(nd.array(x[:, :3]), nd.array(lab)).asnumpy()
     np.testing.assert_allclose(out, x[:, :3], rtol=1e-6)  # identity forward
+
+
+def _conv_ref(x, w, b, stride, pad, dilate=(1, 1), groups=1):
+    """Plain numpy conv reference (NCHW, OIHW)."""
+    N, C, H, W = x.shape
+    O, Ig, KH, KW = w.shape
+    sh, sw = stride
+    ph, pw = pad
+    dh, dw = dilate
+    xp = np.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    eh = (KH - 1) * dh + 1
+    ew = (KW - 1) * dw + 1
+    OH = (H + 2 * ph - eh) // sh + 1
+    OW = (W + 2 * pw - ew) // sw + 1
+    out = np.zeros((N, O, OH, OW), np.float32)
+    cg = C // groups
+    og = O // groups
+    for n in range(N):
+        for o in range(O):
+            g = o // og
+            for i in range(OH):
+                for j in range(OW):
+                    patch = xp[n, g * cg:(g + 1) * cg,
+                               i * sh:i * sh + eh:dh,
+                               j * sw:j * sw + ew:dw]
+                    out[n, o, i, j] = (patch * w[o]).sum()
+    if b is not None:
+        out += b.reshape(1, -1, 1, 1)
+    return out
+
+
+@pytest.mark.parametrize("stride,pad,groups,dilate", [
+    ((1, 1), (0, 0), 1, (1, 1)),
+    ((2, 2), (1, 1), 1, (1, 1)),
+    ((1, 1), (1, 1), 2, (1, 1)),
+    ((1, 1), (2, 2), 1, (2, 2)),
+])
+def test_convolution_variants(stride, pad, groups, dilate):
+    x = _rand((2, 4, 7, 7), -1, 1)
+    w = _rand((6, 4 // groups, 3, 3), -1, 1)
+    b = _rand((6,), -1, 1)
+    got = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         num_filter=6, kernel=(3, 3), stride=stride,
+                         pad=pad, num_group=groups, dilate=dilate).asnumpy()
+    want = _conv_ref(x, w, b, stride, pad, dilate, groups)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_pooling_variants():
+    x = _rand((1, 2, 6, 6), -1, 1)
+    got = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type="max").asnumpy()
+    want = x.reshape(1, 2, 3, 2, 3, 2).max((3, 5))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    got = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type="avg").asnumpy()
+    want = x.reshape(1, 2, 3, 2, 3, 2).mean((3, 5))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    got = nd.Pooling(nd.array(x), kernel=(1, 1), global_pool=True,
+                     pool_type="avg").asnumpy()
+    np.testing.assert_allclose(got, x.mean((2, 3), keepdims=True), rtol=1e-5)
+    got = nd.Pooling(nd.array(x), kernel=(1, 1), global_pool=True,
+                     pool_type="max").asnumpy()
+    np.testing.assert_allclose(got, x.max((2, 3), keepdims=True), rtol=1e-6)
+
+
+def test_batchnorm_running_stats_update():
+    """Training mode must update running mean/var with the momentum rule
+    (aux states), eval mode must USE them (reference batch_norm-inl.h)."""
+    x = _rand((8, 3, 4, 4), -2, 2)
+    s = sym.BatchNorm(sym.Variable("data"), name="bn", momentum=0.9,
+                      fix_gamma=False)
+    exe = s.simple_bind(mx.cpu(), grad_req="null", data=x.shape)
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["bn_gamma"][:] = np.ones(3, np.float32)
+    exe.arg_dict["bn_beta"][:] = np.zeros(3, np.float32)
+    rm0 = exe.aux_dict["bn_moving_mean"].asnumpy().copy()
+    exe.forward(is_train=True)
+    rm1 = exe.aux_dict["bn_moving_mean"].asnumpy()
+    bm = x.mean((0, 2, 3))
+    np.testing.assert_allclose(rm1, 0.9 * rm0 + 0.1 * bm, rtol=1e-4,
+                               atol=1e-5)
+    # eval must use the running stats — make them DIFFERENT from the batch
+    # stats so a batch-stats regression cannot slip through
+    rmean = bm + 1.0
+    rvar = x.var((0, 2, 3)) * 2.0 + 0.5
+    exe.aux_dict["bn_moving_mean"][:] = rmean
+    exe.aux_dict["bn_moving_var"][:] = rvar
+    out = exe.forward(is_train=False)[0].asnumpy()
+    want = (x - rmean.reshape(1, 3, 1, 1)) / np.sqrt(
+        rvar.reshape(1, 3, 1, 1) + 1e-3)
+    np.testing.assert_allclose(out, want, rtol=1e-3, atol=1e-3)
+
+
+def test_deconvolution_matches_grad_of_conv():
+    """Deconvolution forward vs an independent numpy transposed-conv
+    scatter reference, plus the adjoint identity
+    <conv(x), y> == <x, deconv(y)>."""
+    x = _rand((1, 2, 6, 6), -1, 1)
+    w = _rand((3, 2, 3, 3), -1, 1)  # conv: in 2 -> out 3
+    y = _rand((1, 3, 4, 4), -1, 1)
+    conv = nd.Convolution(nd.array(x), nd.array(w), num_filter=3,
+                          kernel=(3, 3), no_bias=True).asnumpy()
+    deconv = nd.Deconvolution(nd.array(y), nd.array(w), num_filter=2,
+                              kernel=(3, 3), no_bias=True).asnumpy()
+    # independent scatter reference: out[c, i+ki, j+kj] += y[o,i,j]*w[o,c,ki,kj]
+    want = np.zeros((1, 2, 6, 6), np.float32)
+    for o in range(3):
+        for c in range(2):
+            for i in range(4):
+                for j in range(4):
+                    want[0, c, i:i + 3, j:j + 3] += y[0, o, i, j] * w[o, c]
+    np.testing.assert_allclose(deconv, want, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose((conv * y).sum(), (x * deconv).sum(),
+                               rtol=1e-3)
