@@ -46,3 +46,8 @@ def test_benchmark_score_example():
                "--networks", "mlp", "--batch-sizes", "4", "--iters", "3",
                "--dtype", "float32")
     assert "images/sec" in out
+
+
+def test_rcnn_demo_example():
+    out = _run("examples/rcnn/demo.py", "--image-size", "64")
+    assert "proposals" in out and "ROI-pooled features" in out

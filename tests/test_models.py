@@ -71,3 +71,52 @@ def test_transformer_train_step():
     y = np.random.randint(0, 30, (8, 8)).astype(np.float32)
     m.fit(NDArrayIter(X, y, batch_size=4), num_epoch=1,
           optimizer='adam', optimizer_params={'learning_rate': 1e-3})
+
+
+def test_lenet_convergence_synthetic():
+    """Train LeNet (conv net) to high accuracy on a separable synthetic
+    image task — the analogue of the reference's tests/python/train/
+    test_conv.py convergence check."""
+    mx.random.seed(7)
+    rng = np.random.RandomState(7)
+    n = 512
+    centers = rng.uniform(0, 1, (10, 1, 28, 28)).astype(np.float32)
+    y = rng.randint(0, 10, n)
+    X = centers[y] + 0.25 * rng.randn(n, 1, 28, 28).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y.astype(np.float32), batch_size=32,
+                           shuffle=True, label_name="softmax_label")
+    sym = models.get_symbol("lenet", num_classes=10)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.fit(it, num_epoch=4, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(factor_type="in",
+                                              magnitude=2.0))
+    acc = dict(mod.score(it, mx.metric.create("acc")))["accuracy"]
+    assert acc > 0.95, acc
+
+
+def test_fp16_compute_dtype_trains():
+    """float16 compute with fp32 master weights trains a small MLP — the
+    analogue of the reference's fp16 training test
+    (tests/python/train/test_dtype.py)."""
+    mx.random.seed(5)
+    rng = np.random.RandomState(5)
+    X = rng.uniform(-1, 1, (256, 10)).astype(np.float32)
+    w = rng.uniform(-1, 1, (10,)).astype(np.float32)
+    y = (X @ w > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True,
+                           label_name="softmax_label")
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu(), compute_dtype="float16")
+    mod.fit(it, num_epoch=6, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3},
+            initializer=mx.initializer.Xavier())
+    acc = dict(mod.score(it, mx.metric.create("acc")))["accuracy"]
+    assert acc > 0.9, acc
+    # master weights stayed fp32
+    args, _ = mod.get_params()
+    assert all(v.asnumpy().dtype == np.float32 for v in args.values())
