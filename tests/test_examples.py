@@ -51,3 +51,8 @@ def test_benchmark_score_example():
 def test_rcnn_demo_example():
     out = _run("examples/rcnn/demo.py", "--image-size", "64")
     assert "proposals" in out and "ROI-pooled features" in out
+
+
+def test_dcgan_example():
+    out = _run("examples/gan/dcgan.py", "--batches", "5")
+    assert "dcgan alternating training ran 5 batches OK" in out
