@@ -108,6 +108,7 @@ class Executor:
         self._fwd_bwd_fn = None
         self.outputs: List[NDArray] = []
         self._monitor_cb = None
+        self._monitored_rng = None
         self._rng_counter = 0
         self._last_rng = None
 
@@ -275,7 +276,7 @@ class Executor:
         arg_values = {n: a._data for n, a in self.arg_dict.items()}
         aux_values = {n: a._data for n, a in self.aux_dict.items()}
         rng = self._next_rng()
-        if self._monitor_cb is not None:
+        if self._monitor_should_run(rng):
             self._run_monitored(arg_values, aux_values, is_train, rng)
         fn = self._get_fwd(bool(is_train))
         outs, aux_up = fn(arg_values, aux_values, rng)
@@ -295,6 +296,11 @@ class Executor:
         arg_values = {n: a._data for n, a in self.arg_dict.items()}
         aux_values = {n: a._data for n, a in self.aux_dict.items()}
         rng = self._last_rng if self._last_rng is not None else self._next_rng()
+        if self._monitor_should_run(rng):
+            # tap every intermediate output for Monitor, exactly as the
+            # reference taps during the training forward
+            # (graph_executor.cc:761-781)
+            self._run_monitored(arg_values, aux_values, True, rng)
         heads = None if out_grads is None else [g._data for g in out_grads]
         old = {n: self.grad_dict[n]._data for n in self._grad_names_list()
                if self.grad_req[n] == "add"}
@@ -369,6 +375,22 @@ class Executor:
                         new_aux, compute_dtype=self._compute_dtype)
 
     # --- monitor (reference graph_executor.cc:761-781 monitor callback) ---
+    def _monitor_should_run(self, rng):
+        """Tap once per step: skip when the callback reports itself idle
+        (Monitor between intervals) and dedupe forward+backward of the
+        same step (same rng key)."""
+        cb = self._monitor_cb
+        if cb is None:
+            return False
+        is_active = getattr(cb, "is_active", None)
+        if is_active is not None and not is_active():
+            return False
+        key = None if rng is None else np.asarray(rng).tobytes()
+        if key is not None and key == self._monitored_rng:
+            return False
+        self._monitored_rng = key
+        return True
+
     def set_monitor_callback(self, callback):
         self._monitor_cb = callback
 

@@ -34,6 +34,9 @@ class Monitor:
                 return
             self.queue.append((self.step, name, self.stat_func(arr)))
 
+        # executors probe this to skip the (costly) internal-output
+        # evaluation entirely on batches where the monitor is idle
+        stat_helper.is_active = lambda: self.activated
         self.stat_helper = stat_helper
 
     def install(self, exe):
