@@ -64,6 +64,12 @@ def main():
                                   label_name="softmax_label")
 
     sym = models.get_symbol(args.network, num_classes=args.num_classes)
+    # distributed runs: non-zero ranks checkpoint under prefix-<rank>
+    # (reference example/image-classification/common/fit.py:29-43)
+    rank = int(os.environ.get("MXNET_TPU_WORKER_RANK",
+                              os.environ.get("MXNET_TPU_PROC_ID", "0")))
+    if args.model_prefix and rank > 0:
+        args.model_prefix = "%s-%d" % (args.model_prefix, rank)
     mod = mx.mod.Module(sym, context=dev)
     tic = time.time()
     mod.fit(train, num_epoch=args.num_epochs,
