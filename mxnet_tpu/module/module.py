@@ -399,6 +399,13 @@ class Module(BaseModule):
         exec/arg_params buffers are refreshed lazily on get_params/eval.
         Falls back to forward_backward + update otherwise."""
         fs = self._fused_fit_state()
+        if fs is not None and fs["hyper"] != self._optimizer._hyperparam_key():
+            # a baked-in hyperparameter (momentum/beta warmup schedule)
+            # mutated mid-training: the compiled step traced the old value —
+            # sync state out and rebuild (same contract as Updater.update_all)
+            self._sync_fused_to_exec()
+            self._fused_fit = None
+            fs = self._fused_fit_state()
         if fs is None:
             self.forward_backward(data_batch)
             self.update()
@@ -462,14 +469,14 @@ class Module(BaseModule):
         params = {n: jnp.array(exec_.arg_dict[n]._data, copy=True)
                   for n in names}
         states = {}
+        hyper_key = self._optimizer._hyperparam_key()
         for n in names:
             i = idx_of[n]
-            if i not in self._updater.states:
-                self._updater.states[i] = self._optimizer.create_state(
-                    i, exec_.arg_dict[n])
+            self._updater.ensure_state(i, exec_.arg_dict[n], key=hyper_key)
             states[n] = state_leaves(self._updater.states[i], copy=True)
         self._fused_fit = {"step": step, "params": params, "states": states,
-                           "names": names, "idx_of": idx_of}
+                           "names": names, "idx_of": idx_of,
+                           "hyper": self._optimizer._hyperparam_key()}
         return self._fused_fit
 
     def _refresh_fused_snapshot(self, fs):
@@ -477,12 +484,11 @@ class Module(BaseModule):
         fused snapshot (after set_params / a manual update), reusing the
         already-compiled step program."""
         exec_ = self._exec_group._exec
+        hyper_key = self._optimizer._hyperparam_key()
         for n in fs["names"]:
             fs["params"][n] = jnp.array(exec_.arg_dict[n]._data, copy=True)
             i = fs["idx_of"][n]
-            if i not in self._updater.states:
-                self._updater.states[i] = self._optimizer.create_state(
-                    i, exec_.arg_dict[n])
+            self._updater.ensure_state(i, exec_.arg_dict[n], key=hyper_key)
             fs["states"][n] = state_leaves(self._updater.states[i], copy=True)
         self._fused_refresh = False
         self._fused_dirty = False

@@ -137,7 +137,7 @@ class Optimizer:
         Other hyperparameters (momentum, betas, rescale_grad, clip) are
         baked in at trace time — callers caching a compiled rule must
         re-trace if they mutate them (Updater.update_all keys its cache on
-        rescale_grad/clip_gradient for this reason).
+        Optimizer._hyperparam_key() for this reason).
         Enables Updater.update_all: the whole parameter tree updated in ONE
         jitted program — the analogue of the reference running its fused
         optimizer kernels (optimizer_op.cc) inside engine bulk segments."""
@@ -197,6 +197,33 @@ class Optimizer:
 
     def _clip_attr(self):
         return -1.0 if self.clip_gradient is None else self.clip_gradient
+
+    # attrs that either enter the jitted rule dynamically (lr/wd via the
+    # stacked lr_arr/wd_arr) or are pure bookkeeping — everything else is
+    # baked into pure_rule() at trace time and must invalidate caches.
+    _DYNAMIC_OR_BOOKKEEPING = frozenset({
+        "lr", "wd", "lr_scheduler", "lr_mult", "wd_mult", "idx2name",
+        "sym", "num_update", "begin_num_update", "_index_update_count"})
+
+    def _hyperparam_key(self):
+        """Hashable tuple of every scalar hyperparameter closed over by
+        pure_rule(). Updater.update_all keys its compiled-rule cache on this
+        so mutating e.g. momentum/beta1 mid-training (a warmup schedule)
+        re-traces instead of being silently ignored on the batched path."""
+        items = []
+        for k in sorted(vars(self)):
+            if k in self._DYNAMIC_OR_BOOKKEEPING:
+                continue
+            v = getattr(self, k)
+            if isinstance(v, np.generic):
+                v = v.item()  # np.float32 etc. compare like Python scalars
+            if v is None or isinstance(v, (int, float, bool, str)):
+                items.append((k, v))
+            else:
+                # non-scalar hyperparam (array/list/...): key on repr so a
+                # mutation still invalidates rather than silently vanishing
+                items.append((k, repr(v)))
+        return tuple(items)
 
 
 # convenience alias (reference keeps `create` at module level)
@@ -576,6 +603,17 @@ class Test(Optimizer):
         state._data = weight._data
 
 
+def _state_structure(s):
+    """Nested (shape, dtype) signature of an optimizer state tree — used to
+    detect when a hyperparameter mutation changed what create_state returns
+    (e.g. momentum 0.0 -> 0.9 turns a None state into a buffer)."""
+    if s is None:
+        return None
+    if isinstance(s, tuple):
+        return tuple(_state_structure(x) for x in s)
+    return (tuple(s.shape), str(s.dtype))
+
+
 class Updater:
     """Closure applying an optimizer keyed by integer index — the object the
     reference installs into KVStore (optimizer.py get_updater / :768ff)."""
@@ -583,13 +621,34 @@ class Updater:
     def __init__(self, optimizer: Optimizer):
         self.optimizer = optimizer
         self.states = {}
+        self._state_keys = {}
         self._tree_fn = None
         self._tree_keys = None
         self._lw_cache = None
 
-    def __call__(self, index, grad, weight):
+    def ensure_state(self, index, weight, key=None):
+        """Create — or structurally refresh — the state for `index`.
+        Refresh matters when a hyperparameter mutation changes the state
+        create_state would build: raising momentum from 0.0 (state None) to
+        nonzero mid-training must materialize a real momentum buffer, or the
+        retraced rule silently keeps running momentum-free SGD.
+        Callers looping over many params pass the precomputed `key` so the
+        sorted-vars walk happens once per step, not once per param. The
+        throwaway create_state on a key change is bounded to once per
+        hyperparam mutation (or checkpoint restore) per param — rare events."""
+        if key is None:
+            key = self.optimizer._hyperparam_key()
         if index not in self.states:
             self.states[index] = self.optimizer.create_state(index, weight)
+        elif self._state_keys.get(index) != key:
+            fresh = self.optimizer.create_state(index, weight)
+            if _state_structure(fresh) != _state_structure(self.states[index]):
+                self.states[index] = fresh
+        self._state_keys[index] = key
+        return self.states[index]
+
+    def __call__(self, index, grad, weight):
+        self.ensure_state(index, weight)
         self.optimizer.update(index, weight, grad, self.states[index])
 
     def update_all(self, pairs):
@@ -609,9 +668,9 @@ class Updater:
                 self(index, grad, weight)
             return
         opt = self.optimizer
+        hyper_key = opt._hyperparam_key()
         for index, _, weight in pairs:
-            if index not in self.states:
-                self.states[index] = opt.create_state(index, weight)
+            self.ensure_state(index, weight, key=hyper_key)
             opt._update_count(index)
 
         keys = tuple(sorted(p[0] for p in pairs))
@@ -627,8 +686,7 @@ class Updater:
             self._lw_cache, lw)
 
         if (self._tree_fn is None or self._tree_keys != keys
-                or getattr(self, "_tree_hyper", None) !=
-                   (opt.rescale_grad, opt.clip_gradient)):
+                or getattr(self, "_tree_hyper", None) != hyper_key):
             def tree_update(weights, grads, states, lr_arr, wd_arr):
                 new_w, new_s = {}, {}
                 for pos, i in enumerate(keys):
@@ -643,7 +701,7 @@ class Updater:
             # would delete them under the caller
             self._tree_fn = jax.jit(tree_update, donate_argnums=(2,))
             self._tree_keys = keys
-            self._tree_hyper = (opt.rescale_grad, opt.clip_gradient)
+            self._tree_hyper = hyper_key
 
         new_w, new_s = self._tree_fn(weights, grads, states, lr_arr, wd_arr)
         for i in keys:
@@ -670,6 +728,7 @@ class Updater:
             else:
                 restored[k] = nd.array(v)
         self.states = restored
+        self._state_keys = {}  # restored states re-validate lazily
 
     def get_states(self):
         def conv(v):

@@ -338,3 +338,57 @@ def test_fused_and_manual_paths_interleave():
     mod_a.set_params(args, auxs)
     mod_a.fit_step(batch)
     assert mod_a._fused_fit is fs_before
+
+
+def test_fit_step_honors_hyperparam_mutation():
+    """Module.fit's fused path bakes optimizer hyperparams into its compiled
+    step; mutating one mid-training (momentum warmup) must rebuild the step
+    so training matches the unfused path exactly. Covers both a value change
+    (0.5 -> 0.9) and the state-structure transition (0.0 -> 0.9: the None
+    momentum state must be re-materialized as a real buffer)."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    def make_mod(momentum):
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+        out = mx.sym.LinearRegressionOutput(fc, mx.sym.Variable("label"),
+                                            name="lro")
+        mod = mx.mod.Module(out, data_names=("data",), label_names=("label",),
+                            context=[mx.cpu()])
+        mod.bind(data_shapes=[("data", (8, 6))],
+                 label_shapes=[("label", (8, 4))])
+        mx.random.seed(42)  # identical init across the two modules
+        mod.init_params(mx.initializer.Uniform(0.1))
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05,
+                                             "momentum": momentum})
+        return mod
+
+    for mom0 in (0.5, 0.0):
+        rng = np.random.RandomState(11)
+        batches = [mx.io.DataBatch(
+            data=[mx.nd.array(rng.randn(8, 6).astype(np.float32))],
+            label=[mx.nd.array(rng.randn(8, 4).astype(np.float32))])
+            for _ in range(4)]
+
+        mod_fused = make_mod(mom0)
+        # reference run: unfused path (forward_backward + update), never
+        # touches fit_step, so no env gating is needed
+        mod_unfused = make_mod(mom0)
+        for step, batch in enumerate(batches):
+            if step == 2:
+                mod_fused._optimizer.momentum = 0.9
+                mod_unfused._optimizer.momentum = 0.9
+            mod_fused.fit_step(batch)
+            # the fused path must actually be active, or this test proves
+            # nothing about the compiled-step rebuild
+            assert isinstance(mod_fused._fused_fit, dict), mod_fused._fused_fit
+            mod_unfused.forward_backward(batch)
+            mod_unfused.update()
+        pf, _ = mod_fused.get_params()
+        pu, _ = mod_unfused.get_params()
+        for n in pf:
+            np.testing.assert_allclose(
+                pf[n].asnumpy(), pu[n].asnumpy(), rtol=2e-5, atol=1e-6,
+                err_msg="mom0=%s %s" % (mom0, n))

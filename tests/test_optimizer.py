@@ -182,3 +182,55 @@ def test_updater_update_all_matches_per_key():
                 np.testing.assert_allclose(
                     w_a.asnumpy(), w_b.asnumpy(), rtol=2e-5, atol=1e-6,
                     err_msg="%s %s step %d" % (name, kw, step))
+
+
+def test_update_all_honors_hyperparam_mutation():
+    """Mutating a baked-in hyperparameter (momentum warmup schedule) between
+    steps must re-trace the batched tree rule, not silently keep the old
+    value (Updater.update_all cache keyed on Optimizer._hyperparam_key).
+    mom0=0.5 checks the value-change retrace; mom0=0.0 checks the state
+    transition None -> buffer (Updater.ensure_state)."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    for mom0 in (0.5, 0.0):
+        rng = np.random.RandomState(3)
+        shape = (5, 4)
+        opt_batched = mx.optimizer.create("sgd", learning_rate=0.1,
+                                          momentum=mom0)
+        opt_eager = mx.optimizer.create("sgd", learning_rate=0.1,
+                                        momentum=mom0)
+        up_batched = mx.optimizer.get_updater(opt_batched)
+        up_eager = mx.optimizer.get_updater(opt_eager)
+        w_b = mx.nd.array(rng.rand(*shape).astype(np.float32))
+        w_e = mx.nd.array(w_b.asnumpy())
+        # closed-form numpy reference (plain SGD+momentum, no wd on plain
+        # weight keys with integer index)
+        w_n = w_b.asnumpy().copy()
+        m_n = np.zeros_like(w_n)
+        mom = mom0
+        for step in range(4):
+            if step == 2:  # momentum warmup kicks in mid-training
+                opt_batched.momentum = 0.9
+                opt_eager.momentum = 0.9
+                mom = 0.9
+                if mom0 == 0.0:
+                    # the state transitions None -> fresh zero buffer, so
+                    # momentum history restarts from zero
+                    m_n = np.zeros_like(m_n)
+            g = mx.nd.array(rng.randn(*shape).astype(np.float32))
+            up_batched.update_all([(0, g, w_b)])
+            up_eager(0, g, w_e)
+            m_n = mom * m_n - 0.1 * g.asnumpy()
+            w_n = w_n + m_n
+            np.testing.assert_allclose(w_b.asnumpy(), w_n, rtol=2e-5,
+                                       atol=1e-6,
+                                       err_msg="batched mom0=%s step %d"
+                                       % (mom0, step))
+            np.testing.assert_allclose(w_e.asnumpy(), w_n, rtol=2e-5,
+                                       atol=1e-6,
+                                       err_msg="eager mom0=%s step %d"
+                                       % (mom0, step))
+    # and the cache key itself must differ across the mutation
+    assert opt_batched._hyperparam_key() != mx.optimizer.create(
+        "sgd", learning_rate=0.1, momentum=0.5)._hyperparam_key()

@@ -140,16 +140,22 @@ def convert(text):
             kh, kw = _kernel_hw(p, 2)
             pool = "max" if str(p.get("pool", "MAX")).upper() == "MAX" else "avg"
             gp = str(p.get("global_pooling", "false")).lower() == "true"
+            # Caffe computes pooling output sizes ceil-mode; 'full' is the
+            # matching convention (reference convert_symbol.py
+            # _convert_pooling_param emits it unconditionally).
             out = mx.sym.Pooling(
                 bot[0], kernel=(kh, kw), pool_type=pool,
                 stride=_pair(p, "stride", 1),
                 pad=_pair(p, "pad", 0),
+                pooling_convention="full",
                 global_pool=gp, name=name)
         elif ltype == "INNERPRODUCT":
             p = l.get("inner_product_param", {})
             out = mx.sym.FullyConnected(
                 mx.sym.Flatten(bot[0]),
-                num_hidden=int(p.get("num_output")), name=name)
+                num_hidden=int(p.get("num_output")),
+                no_bias=str(p.get("bias_term", "true")).lower() == "false",
+                name=name)
         elif ltype == "RELU":
             out = mx.sym.Activation(bot[0], act_type="relu", name=name)
         elif ltype == "SIGMOID":
