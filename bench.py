@@ -1,26 +1,48 @@
-"""Benchmark: ResNet-50 training throughput, single chip, batch 32 —
-the reference's headline number (docs/how_to/perf.md:179-188,
-train_imagenet.py): P100 = 181.53 img/s. vs_baseline = ours / 181.53.
+"""Benchmark: ResNet-50 training, single chip — headline metric is MFU.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference's headline table is img/s (docs/how_to/perf.md:179-188,
+train_imagenet.py: P100 = 181.53 img/s @ bs32); this repo's north star
+(BASELINE.md) is stated as MFU, so the benchmark emits both, with the FLOP
+model and peak stated explicitly in the JSON:
 
-Design: the whole training step is TWO jitted XLA computations — fused
-forward+backward from the symbolic graph (executor._get_fwd_bwd; the
-reference's bulk-exec segments collapsed into one compilation, SURVEY §7)
-and one whole-tree fused SGD-momentum update (the reference's per-weight
-sgd_mom_update kernels batched into a single program).
+- FLOP model: analytic 2-FLOPs-per-MAC count over the graph's matmul ops
+  (mxnet_tpu/flops.py; ResNet-50 fwd = 8.18 GFLOPs/img @224^2), training
+  step = 3x forward (backward = 2x forward matmul work).
+- Denominator: the chip's NOMINAL bf16 peak (mxnet_tpu.flops.CHIP_PEAK_BF16
+  by device_kind; override with BENCH_PEAK_TFLOPS).
+- Timing: MEDIAN of BENCH_REPEATS timed blocks of BENCH_ITERS steps each
+  (best-of-N over-reports under contention noise); sync = device->host
+  readback of one output element before/after each block. BENCH_PER_ITER=1
+  additionally reports median per-step wall time with a sync every step as
+  a cross-check.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+vs_baseline = MFU / 0.45 (the BASELINE.md north-star target) when MFU is
+computable, else img_per_sec / 181.53 (P100 reference row).
+
+Design: the whole training step is TWO jitted XLA computations fused into
+ONE program via Executor.make_train_step — forward+backward from the
+symbolic graph plus a whole-tree fused SGD-momentum update with donated
+buffers (the reference's bulk-exec segments + fused sgd_mom_update kernels
+collapsed into a single compilation, SURVEY §7).
 """
 import json
 import os
+import statistics
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-BATCH = int(os.environ.get("BENCH_BATCH", "32"))
-BASELINE = 181.53  # P100 ResNet-50 training img/s
+# Default batch 256: the TPU-idiomatic per-chip batch (the reference's
+# table is bs32-per-GPU; BENCH_BATCH=32 reproduces that config — both are
+# recorded in the JSON via the metric name).
+BATCH = int(os.environ.get("BENCH_BATCH", "256"))
+P100_IMGS_PER_SEC = 181.53  # reference ResNet-50 training @bs32
+MFU_TARGET = 0.45           # BASELINE.md north star
 WARMUP = 3
 ITERS = int(os.environ.get("BENCH_ITERS", "100"))
+REPEATS = max(1, int(float(os.environ.get("BENCH_REPEATS", "5"))))
 
 
 def main():
@@ -28,6 +50,7 @@ def main():
     import jax
     import jax.numpy as jnp
     import mxnet_tpu as mx
+    from mxnet_tpu import flops as flops_mod
     from mxnet_tpu import models
 
     sym = models.get_symbol("resnet-50", num_classes=1000)
@@ -35,8 +58,14 @@ def main():
     # bf16 compute / f32 master weights: the MXU-native mixed-precision path
     # (executor compute_dtype; override with BENCH_DTYPE=float32).
     cdtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    # grad only for parameters: data/label get grad_req null, exactly like
+    # Module training (a data gradient would add a full backward-data conv
+    # through the stem — measurably wasted work).
+    arg_names = sym.list_arguments()
+    grad_req = {n: ("null" if n in ("data", "softmax_label") else "write")
+                for n in arg_names}
     exe = sym.simple_bind(mx.Context("tpu", 0) if jax.default_backend() != "cpu"
-                          else mx.cpu(), grad_req="write",
+                          else mx.cpu(), grad_req=grad_req,
                           compute_dtype=cdtype,
                           data=data_shape, softmax_label=(BATCH,))
     # init weights
@@ -62,41 +91,83 @@ def main():
             new_m[n] = m
         return new_p, new_m
 
-    # ONE fused XLA program per step (fwd+bwd+SGD, donated buffers) — the
-    # whole-step bulk-exec path (Executor.make_train_step).
+    # ONE fused XLA program per step (fwd+bwd+SGD, donated buffers).
+    # Snapshot the weights first: step() donates its inputs, and the
+    # executor's own buffers must stay live (donation contract).
     step = exe.make_train_step(sgd_all)
-    params = {n: exe.arg_dict[n]._data for n in param_names}
+    params = {n: jnp.array(exe.arg_dict[n]._data, copy=True)
+              for n in param_names}
     moms = {n: jnp.zeros_like(v) for n, v in params.items()}
     feed = {"data": x, "softmax_label": y}
 
     def sync():
         # device->host readback of one element: a REAL sync even where
         # block_until_ready is unreliable (tunneled device platforms).
-        import numpy as _np
-        return _np.asarray(jnp.reshape(outs[0], (-1,))[0])
+        return np.asarray(jnp.reshape(outs[0], (-1,))[0])
 
     for _ in range(WARMUP):
         outs, params, moms = step(params, moms, feed)
     sync()
 
-    # best-of-N repeats: the shared/tunneled dev chip has run-to-run
-    # contention noise; peak sustained throughput is the meaningful number
-    best_dt = None
-    for _ in range(max(1, int(float(os.environ.get("BENCH_REPEATS", "3"))))):
+    # median-of-N timed blocks (the shared/tunneled dev chip has
+    # run-to-run contention noise; median is robust without the
+    # optimistic bias of best-of-N)
+    block_times = []
+    for _ in range(REPEATS):
         t0 = time.perf_counter()
         for _ in range(ITERS):
             outs, params, moms = step(params, moms, feed)
         sync()
-        dt = time.perf_counter() - t0
-        best_dt = dt if best_dt is None else min(best_dt, dt)
+        block_times.append(time.perf_counter() - t0)
+    step_time = statistics.median(block_times) / ITERS
 
-    imgs_per_sec = BATCH * ITERS / best_dt
-    print(json.dumps({
-        "metric": "resnet50_train_imgs_per_sec_bs%d" % BATCH,
-        "value": round(imgs_per_sec, 2),
-        "unit": "images/sec",
-        "vs_baseline": round(imgs_per_sec / BASELINE, 3),
-    }))
+    per_iter_ms = None
+    if os.environ.get("BENCH_PER_ITER"):
+        # cross-check: per-step wall time with a sync EVERY step (upper
+        # bound: includes one dispatch+readback latency per step)
+        ts = []
+        for _ in range(min(ITERS, 30)):
+            t0 = time.perf_counter()
+            outs, params, moms = step(params, moms, feed)
+            sync()
+            ts.append(time.perf_counter() - t0)
+        per_iter_ms = round(statistics.median(ts) * 1e3, 3)
+
+    imgs_per_sec = BATCH / step_time
+
+    fwd_flops_img = flops_mod.count_flops(
+        sym, data=(1, 3, 224, 224), softmax_label=(1,))["total"]
+    train_flops_img = flops_mod.training_flops(fwd_flops_img)
+    peak, kind = flops_mod.chip_peak_flops()
+    if os.environ.get("BENCH_PEAK_TFLOPS"):
+        peak = float(os.environ["BENCH_PEAK_TFLOPS"]) * 1e12
+    achieved = imgs_per_sec * train_flops_img
+    mfu = achieved / peak if peak else None
+
+    rec = {
+        "metric": "resnet50_train_mfu_bs%d" % BATCH,
+        "value": round(100.0 * mfu, 2) if mfu is not None else round(imgs_per_sec, 2),
+        "unit": "percent_of_bf16_peak" if mfu is not None else "images/sec",
+        "vs_baseline": round(mfu / MFU_TARGET, 3) if mfu is not None
+                       else round(imgs_per_sec / P100_IMGS_PER_SEC, 3),
+        "img_per_sec": round(imgs_per_sec, 2),
+        "vs_p100_ref": round(imgs_per_sec / P100_IMGS_PER_SEC, 3),
+        "step_time_ms": round(step_time * 1e3, 3),
+        "flop_formula": "2 FLOPs/MAC over Conv+FC (fwd=%.3f GF/img), "
+                        "train=3x fwd=%.3f GF/img" % (
+                            fwd_flops_img / 1e9, train_flops_img / 1e9),
+        "chip": kind,
+        "chip_peak_tflops": round(peak / 1e12, 1) if peak else None,
+        "achieved_tflops": round(achieved / 1e12, 2),
+        "timing": "median of %d blocks x %d iters, readback sync" % (
+            REPEATS, ITERS),
+        "compute_dtype": cdtype,
+    }
+    if mfu is None:
+        rec["metric"] = "resnet50_train_imgs_per_sec_bs%d" % BATCH
+    if per_iter_ms is not None:
+        rec["per_iter_ms_synced"] = per_iter_ms
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

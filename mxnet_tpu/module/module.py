@@ -476,7 +476,7 @@ class Module(BaseModule):
             states[n] = state_leaves(self._updater.states[i], copy=True)
         self._fused_fit = {"step": step, "params": params, "states": states,
                            "names": names, "idx_of": idx_of,
-                           "hyper": self._optimizer._hyperparam_key()}
+                           "hyper": hyper_key}
         return self._fused_fit
 
     def _refresh_fused_snapshot(self, fs):

@@ -18,6 +18,8 @@ Design notes (TPU-first):
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -125,12 +127,47 @@ _CONV_SPEC = {
 }
 
 
+def _stem_conv_s2d(data, weight, bias):
+    """7x7-stride-2-pad-3 stem conv via 2x2 space-to-depth — numerically
+    identical, but the MXU sees 4x the input channels (C=3 pads to 128
+    lanes catastrophically; C*4=12 with a 4x4 kernel quadruples the
+    contraction utilization). This is the cudnn-fastpath analogue for the
+    ImageNet stem (SURVEY §2.1 #16): same registry op, faster lowering.
+
+    out[h] = sum_r w[r] x_pad[2h+r]; splitting r=2q+p turns the stride-2
+    8-tap window into a stride-1 4-tap window over 2x2-blocked input:
+    out[h] = sum_{q,p} w[2q+p] x_pad[2(h+q)+p].
+    """
+    N, C, H, W = data.shape
+    K = weight.shape[0]
+    xp = jnp.pad(data, ((0, 0), (0, 0), (3, 3), (3, 3)))
+    hp, wp_ = (H + 6) // 2, (W + 6) // 2
+    xs = xp.reshape(N, C, hp, 2, wp_, 2)
+    xs = xs.transpose(0, 1, 3, 5, 2, 4).reshape(N, C * 4, hp, wp_)
+    wpad = jnp.pad(weight, ((0, 0), (0, 0), (0, 1), (0, 1)))  # 7 -> 8 taps
+    ws = wpad.reshape(K, C, 4, 2, 4, 2)
+    ws = ws.transpose(0, 1, 3, 5, 2, 4).reshape(K, C * 4, 4, 4)
+    dn = jax.lax.conv_dimension_numbers(xs.shape, ws.shape, ("NCHW", "OIHW", "NCHW"))
+    out = jax.lax.conv_general_dilated(
+        xs, ws, window_strides=(1, 1), padding="VALID", dimension_numbers=dn)
+    if bias is not None:
+        out = out + bias.reshape((1, -1, 1, 1))
+    return out
+
+
 def _conv_forward(attrs, data, weight, bias):
     kernel = tuple(attrs["kernel"])
     n = len(kernel)
     stride = _ntuple(attrs["stride"], n)
     dilate = _ntuple(attrs["dilate"], n)
     pad = _ntuple(attrs["pad"], n) if attrs["pad"] else (0,) * n
+    if (kernel == (7, 7) and stride == (2, 2) and pad == (3, 3)
+            and dilate == (1, 1) and int(attrs["num_group"]) == 1
+            and data.ndim == 4 and data.shape[1] <= 4
+            and data.shape[0] >= 128  # measured: wins at large batch only
+            and data.shape[2] % 2 == 0 and data.shape[3] % 2 == 0
+            and os.environ.get("MXNET_CONV_S2D", "1") != "0"):
+        return _stem_conv_s2d(data, weight, bias)
     dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dnums(n))
     out = jax.lax.conv_general_dilated(
         data,
@@ -298,8 +335,28 @@ def _batch_norm(attrs, inputs, aux, ctx):
         gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
     use_batch = ctx.is_train and not attrs["use_global_stats"]
     if use_batch:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        if data.dtype == jnp.bfloat16:
+            # One-pass sufficient statistics: sum(d) and sum(d*d) are
+            # sibling reduces over the same operand, which XLA fuses into a
+            # SINGLE read of the activation (jnp.var would serialize two
+            # passes: mean, then mean((x-mean)^2)). Shifting by the moving
+            # mean (a running estimate of the batch mean) conditions the
+            # E[d^2]-E[d]^2 subtraction, and the f32 accumulation (the cast
+            # fuses into the reduce) carries 24 mantissa bits; bf16-only
+            # because f32 inputs with |mean|>>std would still lose to
+            # cancellation relative to the two-pass algorithm.
+            n = 1
+            for i in red:
+                n *= data.shape[i]
+            shift = jax.lax.stop_gradient(moving_mean.astype(jnp.float32))
+            d = data.astype(jnp.float32) - shift.reshape(bshape)
+            dmean = jnp.sum(d, axis=red) / n
+            var = jnp.maximum(jnp.sum(d * d, axis=red) / n - dmean * dmean, 0.0)
+            mean = (shift + dmean).astype(data.dtype)
+            var = var.astype(data.dtype)
+        else:
+            mean = jnp.mean(data, axis=red)
+            var = jnp.var(data, axis=red)
         m = attrs["momentum"]
         aux_updates = (
             moving_mean * m + mean * (1 - m),

@@ -664,8 +664,10 @@ class Updater:
 
         rule = self.optimizer.pure_rule()
         if rule is None:
+            hyper_key = self.optimizer._hyperparam_key()
             for index, grad, weight in pairs:
-                self(index, grad, weight)
+                self.ensure_state(index, weight, key=hyper_key)
+                self.optimizer.update(index, weight, grad, self.states[index])
             return
         opt = self.optimizer
         hyper_key = opt._hyperparam_key()

@@ -218,3 +218,79 @@ def test_clip_smooth_l1():
     sl = nd.smooth_l1(nd.array(x), scalar=1.0).asnumpy()
     expected = np.where(np.abs(x) < 1, 0.5 * x ** 2, np.abs(x) - 0.5)
     np.testing.assert_allclose(sl, expected, rtol=1e-5)
+
+
+def test_stem_conv_space_to_depth_equivalence():
+    """The 7x7/s2/p3 stem fast path (ops/nn.py _stem_conv_s2d, the
+    cudnn-fastpath analogue) must be numerically identical to the plain
+    lowering, forward and gradient."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import nn as nnops
+
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(2, 3, 32, 32).astype(np.float32))
+    w = jnp.asarray(rng.randn(8, 3, 7, 7).astype(np.float32))
+    b = jnp.asarray(rng.randn(8).astype(np.float32))
+    attrs = {"kernel": (7, 7), "stride": (2, 2), "pad": (3, 3),
+             "dilate": (), "num_group": 1, "no_bias": False}
+    ref = nnops._conv_forward(attrs, x, w, b)   # batch 2 < 128: plain path
+    fast = nnops._stem_conv_s2d(x, w, b)
+    assert fast.shape == ref.shape == (2, 8, 16, 16)
+    np.testing.assert_allclose(np.asarray(fast), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    g_ref = jax.grad(lambda w: jnp.sum(nnops._conv_forward(attrs, x, w, b) ** 2))(w)
+    g_fast = jax.grad(lambda w: jnp.sum(nnops._stem_conv_s2d(x, w, b) ** 2))(w)
+    np.testing.assert_allclose(np.asarray(g_fast), np.asarray(g_ref),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_batchnorm_one_pass_stats():
+    """BN train-mode stats via one-pass sufficient statistics must match
+    numpy mean/var (f32 accumulation keeps E[x^2]-E[x]^2 conditioned)."""
+    x = (np.random.RandomState(3).randn(8, 5, 6, 6) * 3 + 7).astype(np.float32)
+    bn = sym.BatchNorm(sym.Variable("data"), fix_gamma=False, momentum=0.9,
+                       eps=1e-5, name="bn")
+    from mxnet_tpu.test_utils import _bind
+
+    exe = _bind(bn, {"data": x, "bn_gamma": np.ones(5, np.float32),
+                     "bn_beta": np.zeros(5, np.float32)}, grad_req="null")
+    out = exe.forward(is_train=True)[0].asnumpy()
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    expected = (x - mean[None, :, None, None]) / np.sqrt(var + 1e-5)[None, :, None, None]
+    np.testing.assert_allclose(out, expected, rtol=1e-3, atol=1e-3)
+    # moving stats updated with the batch stats
+    np.testing.assert_allclose(exe.aux_dict["bn_moving_mean"].asnumpy(),
+                               0.9 * 0 + 0.1 * mean, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(exe.aux_dict["bn_moving_var"].asnumpy(),
+                               0.9 * 0 + 0.1 * var, rtol=1e-3, atol=1e-2)
+
+
+def test_batchnorm_bf16_one_pass_path():
+    """bf16 activations take the shifted one-pass statistics path
+    (ops/nn.py _batch_norm); stats must match numpy within bf16 tolerance
+    even with a nonzero moving-mean shift."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.registry import get_op
+    from mxnet_tpu.ops import OpContext
+
+    rng = np.random.RandomState(11)
+    x = (rng.randn(16, 4, 8, 8) * 2 + 5).astype(np.float32)
+    op = get_op("BatchNorm")
+    attrs = op.parse_attrs({"fix_gamma": False, "momentum": 0.9, "eps": 1e-5})
+    gamma = jnp.ones(4, jnp.bfloat16)
+    beta = jnp.zeros(4, jnp.bfloat16)
+    mov_mean = jnp.asarray(rng.randn(4).astype(np.float32), jnp.bfloat16) + 5
+    mov_var = jnp.ones(4, jnp.bfloat16)
+    (out,), (new_mean, new_var) = op.impl(
+        attrs, (jnp.asarray(x, jnp.bfloat16), gamma, beta),
+        (mov_mean, mov_var), OpContext(is_train=True, rng=None))
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    expect = (x - mean[None, :, None, None]) / np.sqrt(var + 1e-5)[None, :, None, None]
+    np.testing.assert_allclose(np.asarray(out, np.float32), expect,
+                               rtol=0.1, atol=0.1)
+    np.testing.assert_allclose(np.asarray(new_mean, np.float32),
+                               0.9 * np.asarray(mov_mean, np.float32) + 0.1 * mean,
+                               rtol=0.05, atol=0.05)
