@@ -142,7 +142,10 @@ def main():
     if os.environ.get("BENCH_PEAK_TFLOPS"):
         peak = float(os.environ["BENCH_PEAK_TFLOPS"]) * 1e12
     achieved = imgs_per_sec * train_flops_img
-    mfu = achieved / peak if peak else None
+    # MFU only against the matching precision peak: the table is bf16, so
+    # a float32 run falls back to the img/s metric instead of dividing by
+    # the wrong denominator.
+    mfu = achieved / peak if (peak and cdtype == "bfloat16") else None
 
     rec = {
         "metric": "resnet50_train_mfu_bs%d" % BATCH,

@@ -58,9 +58,16 @@ def chip_peak_flops(device=None) -> Tuple[float, str]:
     if device is None:
         device = jax.devices()[0]
     kind = getattr(device, "device_kind", str(device))
-    for key, peak in CHIP_PEAK_BF16.items():
-        if kind.startswith(key) or key.startswith(kind):
-            return peak, kind
+    if kind in CHIP_PEAK_BF16:
+        return CHIP_PEAK_BF16[kind], kind
+    # longest-prefix match on the device kind only ("TPU v5 lite core"
+    # -> "TPU v5 lite", never "TPU v5 lite" -> the v5p "TPU v5" entry)
+    best = ""
+    for key in CHIP_PEAK_BF16:
+        if kind.startswith(key) and len(key) > len(best):
+            best = key
+    if best:
+        return CHIP_PEAK_BF16[best], kind
     return 0.0, kind
 
 
