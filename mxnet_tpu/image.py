@@ -443,6 +443,20 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0.0, rand_pad=0.0,
     return auglist
 
 
+def parse_det_label(raw, object_width=5):
+    """Decode a packed det label: either a flat multiple of object_width,
+    or [header_width, object_width, ...header, objects...] (the reference
+    det format, tools/im2rec packing). Returns (k, <=object_width)."""
+    raw = np.asarray(raw, np.float32).reshape(-1)
+    if len(raw) == 0:
+        return np.zeros((0, object_width), np.float32)
+    if len(raw) >= 2 and len(raw) % object_width != 0:
+        hw, ow = int(raw[0]), int(raw[1])
+        body = raw[hw:]
+        return body.reshape(-1, ow)[:, :object_width].astype(np.float32)
+    return raw.reshape(-1, object_width).astype(np.float32)
+
+
 class ImageDetIter(ImageIter):
     """Detection iterator (reference ImageDetRecordIter,
     src/io/iter_image_det_recordio.cc + python image.ImageDetIter): yields
@@ -473,14 +487,7 @@ class ImageDetIter(ImageIter):
 
     @staticmethod
     def _parse_label(raw):
-        raw = np.asarray(raw, np.float32).reshape(-1)
-        if len(raw) == 0:
-            return np.zeros((0, 5), np.float32)
-        if len(raw) >= 2 and len(raw) % 5 != 0:
-            hw, ow = int(raw[0]), int(raw[1])
-            body = raw[hw:]
-            return body.reshape(-1, ow)[:, :5].astype(np.float32)
-        return raw.reshape(-1, 5).astype(np.float32)
+        return parse_det_label(raw, 5)
 
     def next(self):
         c, h, w = self.data_shape

@@ -368,4 +368,5 @@ class PrefetchingIter(DataIter):
 
 # Registered iterators (reference MXNET_REGISTER_IO_ITER classes) live in
 # io_iters.py; re-exported here so callers use mx.io.ImageRecordIter etc.
-from .io_iters import ImageRecordIter, CSVIter, MNISTIter  # noqa: E402,F401
+from .io_iters import (ImageRecordIter, ImageDetRecordIter, CSVIter,  # noqa: E402,F401
+                       MNISTIter)

@@ -305,3 +305,164 @@ class MNISTIter(DataIter):
         self._cursor += self.batch_size
         return DataBatch([nd.array(self._images[idx])],
                          [nd.array(self._labels[idx])], pad=pad)
+
+
+class ImageDetRecordIter(DataIter):
+    """Detection .rec iterator (reference ImageDetRecordIter,
+    src/io/iter_image_recordio_2.cc:579 + image_det_aug_default.cc).
+
+    Reads packed records through the native RecordIO reader (sharded by
+    part_index/num_parts exactly like the classification iterator), decodes
+    on host, applies the box-aware Det* augmenter chain (image.py:283 —
+    crop/pad/resize/flip keep boxes consistent), and emits
+    (data (B,C,H,W), label (B, max_objs, object_width)) with rows padded by
+    ``label_pad_value`` (-1), the layout MultiBoxTarget consumes.
+
+    Record label layout follows the reference det format: either a flat
+    multiple of ``object_width``, or ``[header_width, object_width,
+    ...header, objects...]`` (tools/im2rec packing).
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, max_objs=16,
+                 object_width=5, label_pad_value=-1.0, shuffle=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0,
+                 num_parts=1, part_index=0, seed=0, round_batch=True,
+                 aug_list=None, label_name="label", **det_kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(int(x) for x in data_shape)
+        self.max_objs = int(max_objs)
+        self.object_width = int(object_width)
+        self.label_pad_value = float(label_pad_value)
+        self._round_batch = round_batch
+        self._label_name = label_name
+        self._provide_data = [DataDesc("data", (batch_size,) + self.data_shape)]
+        self._provide_label = [DataDesc(
+            label_name, (batch_size, self.max_objs, self.object_width))]
+        self._reader = _ShardedRecordStream(
+            path_imgrec, part_index, num_parts, seed,
+            shuffle_buffer=(max(4 * batch_size, 2048) if shuffle else 0))
+        if aug_list is None:
+            from .image import CreateDetAugmenter
+
+            std = (np.asarray([std_r, std_g, std_b], np.float32)
+                   if (std_r != 1.0 or std_g != 1.0 or std_b != 1.0) else None)
+            # std-only normalization still needs an (all-zero) mean:
+            # ColorNormalizeAug is only appended when mean is present
+            mean = (np.asarray([mean_r, mean_g, mean_b], np.float32)
+                    if (mean_r or mean_g or mean_b or std is not None)
+                    else None)
+            aug_list = CreateDetAugmenter(self.data_shape, mean=mean, std=std,
+                                          **det_kwargs)
+        self.det_auglist = aug_list
+
+    @property
+    def provide_data(self):
+        return self._provide_data
+
+    @property
+    def provide_label(self):
+        return self._provide_label
+
+    def reset(self):
+        self._reader.reset()
+
+    def next(self):
+        import cv2
+
+        from . import recordio
+        from .image import parse_det_label
+
+        c, h, w = self.data_shape
+        data = np.zeros((self.batch_size, c, h, w), np.float32)
+        label = np.full((self.batch_size, self.max_objs, self.object_width),
+                        self.label_pad_value, np.float32)
+        n = 0
+        while n < self.batch_size:
+            buf = self._reader.read()
+            if buf is None:
+                break
+            header, img_bytes = recordio.unpack(buf)
+            img = cv2.imdecode(np.frombuffer(img_bytes, np.uint8),
+                               cv2.IMREAD_COLOR)
+            if img is None:
+                continue
+            img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+            boxes = parse_det_label(header.label, self.object_width)
+            aimg = nd.array(img.astype(np.float32))
+            for aug in self.det_auglist:
+                aimg, boxes = aug(aimg, boxes)
+            data[n] = aimg.asnumpy().transpose(2, 0, 1)
+            k = min(len(boxes), self.max_objs)
+            if k:
+                # records may pack fewer columns than object_width; the
+                # remainder stays at label_pad_value
+                cols = min(boxes.shape[1], self.object_width)
+                label[n, :k, :cols] = boxes[:k, :cols]
+            n += 1
+        if n == 0:
+            raise StopIteration
+        pad = self.batch_size - n
+        if pad and not self._round_batch:
+            data = data[:n]
+            label = label[:n]
+        return DataBatch([nd.array(data)], [nd.array(label)], pad=pad)
+
+
+class _ShardedRecordStream:
+    """Raw record stream: native reader (native/recordio.cc) when built,
+    MXRecordIO fallback; part sharding + bounded-pool streaming shuffle
+    (dmlc InputSplit + RandomSkipper analogue)."""
+
+    def __init__(self, path, part_index, num_parts, seed, shuffle_buffer=0):
+        self._native = None
+        self._py = None
+        self._path = path
+        self._part = (part_index, num_parts)
+        try:
+            from .native import NativeRecordReader
+
+            self._native = NativeRecordReader(path, part_index, num_parts)
+        except Exception:
+            from . import recordio
+
+            self._py = recordio.MXRecordIO(path, "r")
+        self._idx = 0
+        self._rng = np.random.RandomState(seed)
+        self._shuffle_buffer = shuffle_buffer
+        self._pool = []
+
+    def reset(self):
+        if self._native is not None:
+            self._native.reset()
+        else:
+            self._py.reset()
+        self._idx = 0
+        self._pool = []
+
+    def _next_sequential(self):
+        if self._native is not None:
+            return self._native.read()
+        part_index, num_parts = self._part
+        while True:
+            buf = self._py.read()
+            if buf is None:
+                return None
+            mine = (self._idx % num_parts) == part_index
+            self._idx += 1
+            if mine:
+                return buf
+
+    def read(self):
+        if self._shuffle_buffer <= 0:
+            return self._next_sequential()
+        while len(self._pool) < self._shuffle_buffer:
+            buf = self._next_sequential()
+            if buf is None:
+                break
+            self._pool.append(buf)
+        if not self._pool:
+            return None
+        i = self._rng.randint(len(self._pool))
+        self._pool[i], self._pool[-1] = self._pool[-1], self._pool[i]
+        return self._pool.pop()

@@ -235,7 +235,11 @@ class PSKVStore(KVStore):
     def pull(self, key, out=None, priority=0):
         keys, grouped = _group_kv(key, out)
         for k, outs in zip(keys, grouped):
-            val = self._client.pull(k)
+            ref_shape = tuple(outs[0].shape)
+            # element count selects the same shard plan as the push side
+            # (kvstore_dist.h EncodeKey); sharded pulls return flat
+            val = self._client.pull(k, size=int(np.prod(ref_shape)))
+            val = np.asarray(val).reshape(ref_shape)
             for o in outs:
                 # preserve the target's mesh sharding (Comm::Broadcast
                 # semantics), as base KVStore.pull does

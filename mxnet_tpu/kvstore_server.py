@@ -49,16 +49,43 @@ from .base import MXNetError
 _AUTH = b"mxnet_tpu_ps"
 
 
-def _uri():
+def _uris():
+    """All configured server addresses. MXNET_TPU_PS_URI is a
+    comma-separated host:port list — one entry per server process (the
+    reference's ps-lite server group, kvstore_dist.h GetServerKeyRanges)."""
     uri = os.environ.get("MXNET_TPU_PS_URI") or os.environ.get(
         "DMLC_PS_ROOT_URI")
     if uri is None:
         return None
-    if ":" in uri:
-        host, port = uri.rsplit(":", 1)
-    else:
-        host, port = uri, os.environ.get("DMLC_PS_ROOT_PORT", "9091")
-    return (host, int(port))
+    out = []
+    for part in uri.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            host, port = part.rsplit(":", 1)
+        else:
+            host, port = part, os.environ.get("DMLC_PS_ROOT_PORT", "9091")
+        out.append((host, int(port)))
+    return out or None
+
+
+def _uri():
+    uris = _uris()
+    return uris[server_id() % len(uris)] if uris else None
+
+
+def server_id() -> int:
+    """This server process's index into the URI list."""
+    return int(os.environ.get("MXNET_TPU_SERVER_ID",
+                              os.environ.get("DMLC_SERVER_ID", "0")))
+
+
+def bigarray_bound() -> int:
+    """Arrays with more elements than this are split evenly across ALL
+    servers (reference MXNET_KVSTORE_BIGARRAY_BOUND,
+    kvstore_dist.h:276-314)."""
+    return int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", "1000000"))
 
 
 def role() -> str:
@@ -227,51 +254,154 @@ class _NumpyUpdater:
 
 
 class PSClient:
-    """Worker-side connection (reference ps::KVWorker ZPush/ZPull)."""
+    """Worker-side connections to the server group (reference
+    ps::KVWorker ZPush/ZPull + the EncodeKey sharding scheme,
+    kvstore_dist.h:276-314): small keys go whole to one hashed server;
+    arrays with more than ``bigarray_bound()`` elements are split into
+    near-equal contiguous ranges, one per server, so no single server
+    carries a whole embedding-sized tensor."""
 
-    def __init__(self, address=None):
-        self.address = address or _uri()
-        if self.address is None:
+    def __init__(self, addresses=None):
+        if (isinstance(addresses, tuple) and len(addresses) == 2
+                and isinstance(addresses[0], str)):
+            addresses = [addresses]  # single (host, port)
+        self.addresses = addresses or _uris()
+        if not self.addresses:
             raise MXNetError(
                 "no parameter server configured: set MXNET_TPU_PS_URI "
-                "(host:port) or DMLC_PS_ROOT_URI/DMLC_PS_ROOT_PORT")
-        self._conn = None
-        self._lock = threading.Lock()
+                "(comma-separated host:port list) or "
+                "DMLC_PS_ROOT_URI/DMLC_PS_ROOT_PORT")
+        self._conns = [None] * len(self.addresses)
+        # per-connection locks: a slow-to-bind server's connect retry must
+        # not block RPCs to servers that are already up
+        self._locks = [threading.Lock() for _ in self.addresses]
 
-    def _connect(self):
-        if self._conn is None:
-            self._conn = Client(self.address, authkey=_AUTH)
-        return self._conn
+    @property
+    def n_servers(self) -> int:
+        return len(self.addresses)
 
-    def _rpc(self, *req):
-        with self._lock:
-            conn = self._connect()
-            conn.send(req)
-            resp = conn.recv()
+    def _ensure_conn(self, sid):
+        """Connect (caller holds self._locks[sid]); retry until the server
+        binds — launchers start workers and servers concurrently, and
+        ps-lite likewise reconnects."""
+        conn = self._conns[sid]
+        if conn is None:
+            deadline = time.time() + float(os.environ.get(
+                "MXNET_TPU_PS_CONNECT_TIMEOUT", "60"))
+            while True:
+                try:
+                    conn = Client(self.addresses[sid], authkey=_AUTH)
+                    break
+                except (ConnectionRefusedError, FileNotFoundError, OSError):
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.2)
+            self._conns[sid] = conn
+        return conn
+
+    @staticmethod
+    def _check(resp):
         if resp[0] != "ok":
             raise MXNetError("ps error: %s" % (resp[1],))
         return resp[1] if len(resp) > 1 else None
 
+    def _rpc(self, sid, *req):
+        with self._locks[sid]:
+            conn = self._ensure_conn(sid)
+            conn.send(req)
+            resp = conn.recv()
+        return self._check(resp)
+
+    def _sharded_rpc(self, reqs):
+        """One request per server, pipelined: send ALL parts, then collect
+        ALL replies — per-server latency overlaps (max, not sum), which is
+        also what lets sync-mode pushes of different parts merge
+        concurrently server-side. reqs: [(sid, req tuple)], one per sid."""
+        sids = [sid for sid, _ in reqs]
+        for sid in sorted(sids):
+            self._locks[sid].acquire()
+        try:
+            conns = {sid: self._ensure_conn(sid) for sid in sids}
+            for sid, req in reqs:
+                conns[sid].send(req)
+            resps = [conns[sid].recv() for sid, _ in reqs]
+        finally:
+            for sid in sorted(sids, reverse=True):
+                self._locks[sid].release()
+        return [self._check(r) for r in resps]
+
+    def _server_of(self, key) -> int:
+        # stable across processes: the built-in hash() is salted per
+        # process, which would send the same string key to different
+        # servers from different workers (deadlock in sync mode)
+        import zlib
+
+        k = key if isinstance(key, int) else zlib.crc32(str(key).encode())
+        return k % self.n_servers
+
+    def _plan(self, key, size):
+        """None for a whole-array key, else [(server, lo, hi)] flat
+        ranges covering [0, size) — the server key ranges of the
+        reference's EncodeKey for big arrays."""
+        n = self.n_servers
+        if n == 1 or size <= bigarray_bound():
+            return None
+        per, rem = divmod(size, n)
+        plan, off = [], 0
+        for i in range(n):
+            ln = per + (1 if i < rem else 0)
+            plan.append((i, off, off + ln))
+            off += ln
+        return plan
+
     def init(self, key, value: np.ndarray):
-        self._rpc("init", key, np.asarray(value))
+        v = np.ascontiguousarray(value)
+        plan = self._plan(key, v.size)
+        if plan is None:
+            self._rpc(self._server_of(key), "init", key, v)
+            return
+        flat = v.reshape(-1)
+        self._sharded_rpc([(sid, ("init", (key, "part", sid), flat[lo:hi]))
+                           for sid, lo, hi in plan])
 
     def push(self, key, value: np.ndarray):
-        self._rpc("push", key, np.asarray(value))
+        v = np.ascontiguousarray(value)
+        plan = self._plan(key, v.size)
+        if plan is None:
+            self._rpc(self._server_of(key), "push", key, v)
+            return
+        flat = v.reshape(-1)
+        self._sharded_rpc([(sid, ("push", (key, "part", sid), flat[lo:hi]))
+                           for sid, lo, hi in plan])
 
-    def pull(self, key) -> np.ndarray:
-        return self._rpc("pull", key)
+    def pull(self, key, size=None) -> np.ndarray:
+        """size (element count) decides the shard plan exactly as on the
+        push side; returns a FLAT array for sharded keys (the caller
+        reshapes to its buffer — KVStoreDist::Pull into recv_buf)."""
+        plan = None if size is None else self._plan(key, size)
+        if plan is None:
+            return self._rpc(self._server_of(key), "pull", key)
+        parts = self._sharded_rpc([(sid, ("pull", (key, "part", sid)))
+                                   for sid, lo, hi in plan])
+        return np.concatenate([np.asarray(p).reshape(-1) for p in parts])
 
     def set_optimizer(self, optimizer):
-        self._rpc("set_optimizer", pickle.dumps(optimizer))
+        blob = pickle.dumps(optimizer)
+        for sid in range(self.n_servers):
+            self._rpc(sid, "set_optimizer", blob)
 
     def set_sync(self, sync: bool):
-        self._rpc("set_sync", sync)
+        for sid in range(self.n_servers):
+            self._rpc(sid, "set_sync", sync)
 
     def barrier(self):
-        self._rpc("barrier")
+        # worker-group barrier rides server 0; per-key sync merging makes
+        # per-server barriers unnecessary (kvstore_dist_server.h sync mode)
+        self._rpc(0, "barrier")
 
     def stop(self):
-        self._rpc("stop")
+        for sid in range(self.n_servers):
+            self._rpc(sid, "stop")
 
 
 def run():

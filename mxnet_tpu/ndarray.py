@@ -418,7 +418,7 @@ def _make_nd_function(op: OpDef):
         return results
 
     fn.__name__ = op.py_name or op.name
-    fn.__doc__ = op.doc
+    fn.__doc__ = op.build_doc()
     return fn
 
 

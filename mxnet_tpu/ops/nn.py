@@ -41,6 +41,11 @@ def _ntuple(v, n):
     "FullyConnected",
     arg_names=lambda attrs: ("data", "weight") if attrs.get("no_bias") else ("data", "weight", "bias"),
     param_spec={"num_hidden": 0, "no_bias": False, "flatten": True},
+    param_docs={
+        "num_hidden": "Number of hidden units (output features).",
+        "no_bias": "Whether to disable the bias term.",
+        "flatten": "Whether to collapse all but the first axis of the input before the matmul.",
+    },
 )
 def _fully_connected(attrs, data, weight, bias=None):
     """out = dot(data.2d, W.T) + b (reference fully_connected-inl.h:76-86)."""
@@ -55,7 +60,8 @@ def _fully_connected(attrs, data, weight, bias=None):
 
 
 # --- Activation -------------------------------------------------------------
-@defop("Activation", arg_names=("data",), param_spec={"act_type": "relu"})
+@defop("Activation", arg_names=("data",), param_spec={"act_type": "relu"},
+       param_docs={"act_type": "Element-wise nonlinearity: relu | sigmoid | tanh | softrelu | softsign | gelu | silu."})
 def _activation(attrs, data):
     """relu/sigmoid/tanh/softrelu (reference src/operator/activation.cc)."""
     act = attrs["act_type"]
@@ -184,10 +190,26 @@ def _conv_forward(attrs, data, weight, bias):
     return out
 
 
+_CONV_PARAM_DOCS = {
+    "kernel": "Spatial kernel size (h, w) or (d, h, w).",
+    "stride": "Window stride per spatial axis; defaults to 1s.",
+    "dilate": "Kernel dilation per spatial axis; defaults to 1s.",
+    "pad": "Implicit zero padding per spatial axis; defaults to 0s.",
+    "num_filter": "Number of output channels.",
+    "num_group": "Grouped-convolution group count (input and output channels split into groups).",
+    "workspace": "Scratch-space hint in MB; accepted for API parity, XLA plans memory itself.",
+    "no_bias": "Whether to disable the bias term.",
+    "cudnn_tune": "Accepted for API parity (off|limited_workspace|fastest); algorithm choice is the compiler's.",
+    "cudnn_off": "Accepted for API parity; there is no cuDNN on TPU.",
+    "layout": "Data layout (NCHW/NCDHW); None means the default NC+spatial.",
+}
+
+
 @defop(
     "Convolution",
     arg_names=lambda attrs: ("data", "weight") if attrs.get("no_bias") else ("data", "weight", "bias"),
     param_spec=_CONV_SPEC,
+    param_docs=_CONV_PARAM_DOCS,
 )
 def _convolution(attrs, data, weight, bias=None):
     """N-d convolution, NCHW/OIHW (reference convolution-inl.h:90-288). The
@@ -203,6 +225,9 @@ alias("Convolution", "Convolution_v1")
     "Deconvolution",
     arg_names=lambda attrs: ("data", "weight") if attrs.get("no_bias", True) else ("data", "weight", "bias"),
     param_spec=dict(_CONV_SPEC, no_bias=True, adj=(), target_shape=()),
+    param_docs=dict(_CONV_PARAM_DOCS,
+                    adj="Extra output size adjustment per spatial axis (disambiguates stride>1 shapes).",
+                    target_shape="Explicit output spatial shape; overrides adj."),
 )
 def _deconvolution(attrs, data, weight, bias=None):
     """Transposed convolution == gradient of Convolution wrt its input
@@ -258,6 +283,15 @@ def _deconvolution(attrs, data, weight, bias=None):
         "pad": (),
         "pooling_convention": "valid",
         "cudnn_off": False,
+    },
+    param_docs={
+        "kernel": "Pooling window size per spatial axis.",
+        "pool_type": "max | avg | sum.",
+        "global_pool": "Pool over the entire spatial extent (kernel ignored).",
+        "stride": "Window stride; defaults to 1s.",
+        "pad": "Implicit padding; defaults to 0s.",
+        "pooling_convention": "Output-shape rounding: valid (floor) or full (ceil, Caffe-compatible).",
+        "cudnn_off": "Accepted for API parity; there is no cuDNN on TPU.",
     },
 )
 def _pooling(attrs, data):
@@ -317,6 +351,15 @@ alias("Pooling", "Pooling_v1")
         "output_mean_var": False,
         "axis": 1,
         "cudnn_off": False,
+    },
+    param_docs={
+        "eps": "Added to variance before rsqrt for numerical stability.",
+        "momentum": "Moving-average decay for the running mean/var aux states.",
+        "fix_gamma": "Pin gamma to 1 with zero gradient (reference default).",
+        "use_global_stats": "Normalize with the moving statistics even in training mode.",
+        "output_mean_var": "Also return the batch mean and variance as outputs.",
+        "axis": "Channel axis to normalize over.",
+        "cudnn_off": "Accepted for API parity; there is no cuDNN on TPU.",
     },
     num_outputs=lambda attrs: 3 if attrs.get("output_mean_var") else 1,
     uses_train=True,
@@ -415,18 +458,22 @@ def _l2_normalization(attrs, data):
     param_spec={"alpha": 1e-4, "beta": 0.75, "knorm": 2.0, "nsize": 5},
 )
 def _lrn(attrs, data):
-    """Cross-channel local response normalization (reference lrn-inl.h)."""
+    """Cross-channel local response normalization (reference lrn-inl.h).
+
+    The channel-window sum is built from nsize shifted slices instead of a
+    generic reduce_window(add): XLA fuses the adds identically, and the
+    generic-computation reduce_window has no linearization rule under
+    jit(grad(...)) in current jax, which would break the fused
+    forward+backward executor path."""
     nsize = int(attrs["nsize"])
     half = nsize // 2
     sq = jnp.square(data)
-    acc = jax.lax.reduce_window(
-        sq,
-        jnp.asarray(0, data.dtype),
-        jax.lax.add,
-        (1, nsize) + (1,) * (data.ndim - 2),
-        (1,) * data.ndim,
-        [(0, 0), (half, half)] + [(0, 0)] * (data.ndim - 2),
-    )
+    pad = [(0, 0), (half, half)] + [(0, 0)] * (data.ndim - 2)
+    sqp = jnp.pad(sq, pad)
+    c = data.shape[1]
+    acc = sqp[:, 0:c]
+    for i in range(1, nsize):
+        acc = acc + sqp[:, i:i + c]
     return data * jnp.power(attrs["knorm"] + attrs["alpha"] / nsize * acc, -attrs["beta"])
 
 

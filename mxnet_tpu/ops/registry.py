@@ -61,6 +61,7 @@ class OpDef:
     doc: str = ""
     py_name: Optional[str] = None  # name exposed in nd/sym namespaces
     output_names: Any = None  # list or fn(attrs)->list; default [name_output]
+    param_docs: Optional[Dict[str, str]] = None  # per-param doc text
 
     def get_arg_names(self, attrs) -> Tuple[str, ...]:
         a = self.arg_names
@@ -103,6 +104,50 @@ class OpDef:
             attrs[key] = default
         return attrs
 
+    def build_doc(self) -> str:
+        """Generate the full user-facing docstring from the registry entry
+        — summary, tensor inputs, and one entry per parameter with
+        type/required-or-default (+ doc text when registered). This is the
+        analogue of the reference generating Python docstrings from each
+        param struct's __FIELDS__ (src/operator/convolution.cc:158,
+        cpp-package/scripts/OpWrapperGenerator.py)."""
+        lines = [(self.doc or "%s operator." % self.name).strip(), ""]
+        defaults = {k: v for k, v in (self.param_spec or {}).items()
+                    if v is not REQUIRED}
+        if self.variadic:
+            inputs = ["*data : NDArray/Symbol (variable number of inputs)"]
+        else:
+            try:
+                inputs = ["%s : NDArray/Symbol" % n
+                          for n in self.get_arg_names(defaults)]
+                inputs += ["%s : NDArray/Symbol (auxiliary state)" % n
+                           for n in self.get_aux_names(defaults)]
+            except Exception:
+                inputs = ["data : NDArray/Symbol"]
+        lines.append("Inputs")
+        lines.append("------")
+        lines.extend(inputs)
+        if self.param_spec:
+            lines.append("")
+            lines.append("Parameters")
+            lines.append("----------")
+            pdocs = self.param_docs or {}
+            for key, default in self.param_spec.items():
+                if default is REQUIRED:
+                    head = "%s : required" % key
+                else:
+                    tname = type(default).__name__ if default is not None else "any"
+                    head = "%s : %s, optional, default=%r" % (key, tname, default)
+                lines.append(head)
+                if key in pdocs:
+                    lines.append("    " + pdocs[key])
+        lines.append("")
+        lines.append("Returns")
+        lines.append("-------")
+        n_out = self.num_outputs
+        lines.append("%s output(s)" % ("variable" if callable(n_out) else n_out))
+        return "\n".join(lines)
+
 
 def register_op(opdef: OpDef) -> OpDef:
     if opdef.name in OP_REGISTRY:
@@ -131,6 +176,7 @@ def defop(
     py_name=None,
     output_names=None,
     simple=True,
+    param_docs=None,
 ):
     """Decorator registering an operator implementation.
 
@@ -161,6 +207,7 @@ def defop(
             doc=fn.__doc__ or "",
             py_name=py_name or name,
             output_names=output_names,
+            param_docs=param_docs,
         )
         register_op(opdef)
         return fn

@@ -617,7 +617,7 @@ def _make_sym_function(op: OpDef):
         return s
 
     fn.__name__ = op.py_name or op.name
-    fn.__doc__ = op.doc
+    fn.__doc__ = op.build_doc()
     return fn
 
 

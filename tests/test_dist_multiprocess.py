@@ -75,3 +75,68 @@ def test_dist_sync_kvstore_multiprocess():
         for p in workers + [server]:
             if p.poll() is None:
                 p.kill()
+
+
+def test_dist_sync_kvstore_two_servers():
+    """Key-range sharding across 2 server processes: the 1200x1200
+    big_shape (1.44M elems > MXNET_KVSTORE_BIGARRAY_BOUND=1M) splits into
+    per-server ranges, so the closed-form check crosses the shard
+    boundary (reference kvstore_dist.h:276-314 EncodeKey)."""
+    n_workers = 2
+    uris = "127.0.0.1:%d,127.0.0.1:%d" % (_free_port(), _free_port())
+    base = dict(os.environ,
+                JAX_PLATFORMS="cpu",
+                MXNET_TPU_PS_URI=uris,
+                MXNET_TPU_NUM_WORKERS=str(n_workers))
+
+    servers = [
+        subprocess.Popen(
+            [sys.executable, SCRIPT],
+            env=dict(base, MXNET_TPU_ROLE="server",
+                     MXNET_TPU_SERVER_ID=str(s)),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for s in range(2)
+    ]
+    deadline = time.time() + 120
+    for s, uri in enumerate(uris.split(",")):
+        host, port = uri.split(":")
+        while time.time() < deadline:
+            if servers[s].poll() is not None:
+                out, _ = servers[s].communicate()
+                raise AssertionError("server %d died:\n%s" % (s, out[-3000:]))
+            try:
+                socket.create_connection((host, int(port)), timeout=1).close()
+                break
+            except OSError:
+                time.sleep(0.3)
+        else:
+            raise AssertionError("server %d never bound %s" % (s, uri))
+
+    workers = [
+        subprocess.Popen(
+            [sys.executable, SCRIPT],
+            env=dict(base, MXNET_TPU_ROLE="worker",
+                     MXNET_TPU_WORKER_RANK=str(r)),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(n_workers)
+    ]
+    try:
+        deadline = time.time() + 300
+        pending = dict(enumerate(workers))
+        while pending and time.time() < deadline:
+            for r, w in list(pending.items()):
+                if w.poll() is not None:
+                    out, _ = w.communicate()
+                    assert w.returncode == 0, (
+                        "worker %d failed:\n%s" % (r, out[-3000:]))
+                    assert "OK" in out
+                    del pending[r]
+            time.sleep(0.2)
+        assert not pending, "workers %s hung" % sorted(pending)
+        for s, p in enumerate(servers):
+            out, _ = p.communicate(timeout=60)
+            assert p.returncode == 0, "server %d failed:\n%s" % (s, out[-3000:])
+    finally:
+        for p in workers + servers:
+            if p.poll() is None:
+                p.kill()

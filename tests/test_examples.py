@@ -41,6 +41,13 @@ def test_ssd_demo_example():
     assert "top detections" in out
 
 
+def test_ssd_train_example():
+    """Detection data plane end-to-end: synthetic det .rec ->
+    ImageDetRecordIter -> MultiBoxTarget -> loss decreasing."""
+    out = _run("examples/ssd/train.py", "--steps", "12", "--image-size", "96")
+    assert "decreasing" in out and "NOT decreasing" not in out
+
+
 def test_benchmark_score_example():
     out = _run("examples/image-classification/benchmark_score.py",
                "--networks", "mlp", "--batch-sizes", "4", "--iters", "3",

@@ -29,20 +29,60 @@ import subprocess
 import sys
 
 
-def launch_local(n, cmd, env_extra=None):
-    """Local multi-process launch (dmlc local tracker analogue)."""
+def launch_local(n, cmd, env_extra=None, n_servers=0):
+    """Local multi-process launch (dmlc local tracker analogue). With
+    n_servers > 0, also spawns that many parameter-server processes and
+    wires every process with the comma-separated MXNET_TPU_PS_URI list
+    (the reference's `launch.py -n W -s S` worker/server topology; big
+    arrays shard across the whole server group, kvstore_dist.h:276-314)."""
+    import socket
+
     procs = []
+    servers = []
     coord = "127.0.0.1:%d" % int(os.environ.get("MXNET_TPU_PORT", "12975"))
+    ps_uri = None
+    if n_servers > 0:
+        ports = []
+        for _ in range(n_servers):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            s.close()
+        ps_uri = ",".join("127.0.0.1:%d" % p for p in ports)
+        for sid in range(n_servers):
+            env = dict(os.environ)
+            env.update(env_extra or {})
+            env["MXNET_TPU_ROLE"] = "server"
+            env["MXNET_TPU_SERVER_ID"] = str(sid)
+            env["MXNET_TPU_PS_URI"] = ps_uri
+            env["MXNET_TPU_NUM_WORKERS"] = str(n)
+            servers.append(subprocess.Popen(cmd, env=env))
     for rank in range(n):
         env = dict(os.environ)
         env.update(env_extra or {})
         env["MXNET_TPU_COORDINATOR"] = coord
         env["MXNET_TPU_NUM_PROCS"] = str(n)
         env["MXNET_TPU_PROC_ID"] = str(rank)
+        if ps_uri:
+            env["MXNET_TPU_ROLE"] = "worker"
+            env["MXNET_TPU_WORKER_RANK"] = str(rank)
+            env["MXNET_TPU_PS_URI"] = ps_uri
+            env["MXNET_TPU_NUM_WORKERS"] = str(n)
         procs.append(subprocess.Popen(cmd, env=env))
     rc = 0
     for p in procs:
         p.wait()
+        rc = rc or p.returncode
+    for p in servers:
+        if rc:
+            # a crashed worker never sends the PS stop command; don't
+            # hang the launcher waiting on servers that will never exit
+            p.terminate()
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
         rc = rc or p.returncode
     return rc
 
@@ -83,6 +123,8 @@ def launch_tpu_pod(args, cmd):
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("-n", "--num-workers", type=int, default=1)
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="parameter-server processes (local launcher)")
     ap.add_argument("--launcher", choices=["local", "ssh", "tpu"],
                     default="local")
     ap.add_argument("--hostfile", help="one host per line (ssh launcher)")
@@ -93,7 +135,8 @@ def main():
         ap.error("no command given")
     cmd = args.command
     if args.launcher == "local":
-        sys.exit(launch_local(args.num_workers, cmd))
+        sys.exit(launch_local(args.num_workers, cmd,
+                              n_servers=args.num_servers))
     elif args.launcher == "ssh":
         if not args.hostfile:
             ap.error("--hostfile required for ssh launcher")
