@@ -78,7 +78,12 @@ class Context:
             if not devs:
                 raise ValueError("No TPU device available for %r" % self)
             return devs[self.device_id]
-        cpus = jax.devices("cpu")
+        try:
+            cpus = jax.devices("cpu")
+        except RuntimeError:
+            # jax_platforms pinned to the accelerator plugin only (no cpu
+            # backend registered): host-context arrays live on the device
+            return jax.devices()[self.device_id % len(jax.devices())]
         return cpus[self.device_id % len(cpus)]
 
 

@@ -94,56 +94,26 @@ class ImageRecordIter(DataIter):
 
 
 class _PyImageRecordReader:
-    """cv2-based fallback matching the native loader's semantics."""
+    """cv2-based fallback matching the native loader's semantics; record
+    sharding + streaming shuffle delegate to _ShardedRecordStream."""
 
     def __init__(self, path, data_shape, rand_crop, rand_mirror, mean, std,
                  resize, part_index, num_parts, seed, shuffle_buffer=0):
-        from . import recordio
-
-        self._rec = recordio.MXRecordIO(path, "r")
+        self._stream = _ShardedRecordStream(path, part_index, num_parts,
+                                            seed, shuffle_buffer)
         self.data_shape = data_shape
         self.rand_crop = rand_crop
         self.rand_mirror = rand_mirror
         self.mean = np.asarray(mean, np.float32).reshape(3, 1, 1)
         self.std = np.asarray(std, np.float32).reshape(3, 1, 1)
         self.resize = resize
-        self.part_index = part_index
-        self.num_parts = num_parts
-        self._idx = 0
         self._rng = np.random.RandomState(seed)
-        self._shuffle_buffer = shuffle_buffer
-        self._pool = []
 
     def reset(self):
-        self._rec.reset()
-        self._idx = 0
-        self._pool = []
-
-    def _next_sequential(self):
-        while True:
-            buf = self._rec.read()
-            if buf is None:
-                return None
-            mine = (self._idx % self.num_parts) == self.part_index
-            self._idx += 1
-            if mine:
-                return buf
+        self._stream.reset()
 
     def _next_my_record(self):
-        """Next record, through the same streaming shuffle window as the
-        native loader (bounded pool refilled sequentially, drawn uniformly)."""
-        if self._shuffle_buffer <= 0:
-            return self._next_sequential()
-        while len(self._pool) < self._shuffle_buffer:
-            buf = self._next_sequential()
-            if buf is None:
-                break
-            self._pool.append(buf)
-        if not self._pool:
-            return None
-        i = self._rng.randint(len(self._pool))
-        self._pool[i], self._pool[-1] = self._pool[-1], self._pool[i]
-        return self._pool.pop()
+        return self._stream.read()
 
     def next_batch(self, batch_size):
         import cv2
@@ -343,6 +313,8 @@ class ImageDetRecordIter(DataIter):
             path_imgrec, part_index, num_parts, seed,
             shuffle_buffer=(max(4 * batch_size, 2048) if shuffle else 0))
         if aug_list is None:
+            import inspect
+
             from .image import CreateDetAugmenter
 
             std = (np.asarray([std_r, std_g, std_b], np.float32)
@@ -352,8 +324,13 @@ class ImageDetRecordIter(DataIter):
             mean = (np.asarray([mean_r, mean_g, mean_b], np.float32)
                     if (mean_r or mean_g or mean_b or std is not None)
                     else None)
+            # forward only the augmenter's own params; other kwargs
+            # (preprocess_threads, prefetch_buffer, ...) are accepted and
+            # ignored like the classification iterator does
+            known = set(inspect.signature(CreateDetAugmenter).parameters)
+            aug_kwargs = {k: v for k, v in det_kwargs.items() if k in known}
             aug_list = CreateDetAugmenter(self.data_shape, mean=mean, std=std,
-                                          **det_kwargs)
+                                          **aug_kwargs)
         self.det_auglist = aug_list
 
     @property
@@ -466,3 +443,24 @@ class _ShardedRecordStream:
         i = self._rng.randint(len(self._pool))
         self._pool[i], self._pool[-1] = self._pool[-1], self._pool[i]
         return self._pool.pop()
+
+
+class ImageRecordUInt8Iter(ImageRecordIter):
+    """Raw-pixel variant: emits uint8 batches with NO mean/std
+    normalization (reference ImageRecordUInt8Iter,
+    iter_image_recordio_2.cc:559 uint8 registration). The point on TPU:
+    4x less host->device traffic — transfer uint8, cast/normalize
+    on-device (DevicePrefetchIter(cast_dtype=...) or a leading BatchNorm
+    like resnet's bn_data)."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size, **kwargs):
+        for banned in ("mean_r", "mean_g", "mean_b", "std_r", "std_g",
+                       "std_b"):
+            kwargs.pop(banned, None)
+        super().__init__(path_imgrec, data_shape, batch_size, **kwargs)
+
+    def next(self):
+        batch = super().next()
+        data = [nd.NDArray(d._data.astype("uint8")) if d._data.dtype != "uint8"
+                else d for d in batch.data]
+        return DataBatch(data, batch.label, pad=batch.pad, index=batch.index)

@@ -235,3 +235,19 @@ def test_image_det_record_iter_sharding(tmp_path):
             seen.append(lab[:2 - batch.pad, 0, 0])
     classes = np.concatenate(seen)
     assert len(classes) == 8  # both shards together cover every record
+
+
+def test_image_record_uint8_iter(tmp_path):
+    """ImageRecordUInt8Iter: raw uint8 batches, no normalization
+    (reference iter_image_recordio_2.cc uint8 registration) — the 4x-
+    smaller wire format for device-side casting."""
+    path = _make_rec(tmp_path, n=6)
+    it = mx.io.ImageRecordUInt8Iter(path_imgrec=path, data_shape=(3, 24, 24),
+                                    batch_size=3,
+                                    mean_r=99.0, std_r=2.0)  # must be ignored
+    batch = it.next()
+    d = batch.data[0]
+    assert str(d._data.dtype) == "uint8"
+    v = d.asnumpy()
+    assert v.shape == (3, 3, 24, 24)
+    assert v.max() > 1  # raw pixel range, not normalized

@@ -73,17 +73,18 @@ def launch_local(n, cmd, env_extra=None, n_servers=0):
     for p in procs:
         p.wait()
         rc = rc or p.returncode
+    # servers only exit on a kv.stop_server() RPC; whether or not the
+    # workers sent one, shut the group down now. Server exit status does
+    # NOT fold into the launcher rc — workers define success (the
+    # reference tracker likewise tears servers down after workers).
     for p in servers:
-        if rc:
-            # a crashed worker never sends the PS stop command; don't
-            # hang the launcher waiting on servers that will never exit
+        if p.poll() is None:
             p.terminate()
         try:
-            p.wait(timeout=30)
+            p.wait(timeout=10)
         except subprocess.TimeoutExpired:
             p.kill()
             p.wait()
-        rc = rc or p.returncode
     return rc
 
 
