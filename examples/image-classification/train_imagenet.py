@@ -55,10 +55,12 @@ def run_io_benchmark(args, shape, dev):
 
     rec = args.data_train
     if rec is None:
+        # enough records that the timed window never wraps (a wrap pays a
+        # full prefetcher teardown/rebuild inside the measurement)
+        n_rec = max(args.io_records, (args.io_steps + 8) * args.batch_size)
         rec = os.path.join(tempfile.mkdtemp(), "synth_imagenet.rec")
-        print("packing %d synthetic records at %s ..." % (args.io_records,
-                                                          str(shape)))
-        _make_synth_rec(rec, args.io_records, shape, args.num_classes)
+        print("packing %d synthetic records at %s ..." % (n_rec, str(shape)))
+        _make_synth_rec(rec, n_rec, shape, args.num_classes)
 
     def make_iter():
         cls = (mx.io.ImageRecordUInt8Iter if args.uint8

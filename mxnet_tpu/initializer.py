@@ -59,6 +59,9 @@ class Initializer:
             self._init_beta(desc, arr)
         elif name.endswith("weight"):
             self._init_weight(desc, arr)
+        elif name.endswith("parameters"):
+            # fused-RNN packed blobs (FusedRNN initializer routes here)
+            self._init_weight(desc, arr)
         elif name.endswith("moving_mean") or name.endswith("running_mean"):
             self._init_zero(desc, arr)
         elif name.endswith("moving_var") or name.endswith("running_var"):
@@ -248,6 +251,29 @@ class FusedRNN(Initializer):
     def _init_weight(self, desc, arr):
         if self._init is not None:
             self._init._init_weight(desc, arr)
+        else:
+            arr[:] = np.random.uniform(-0.07, 0.07, arr.shape).astype(
+                "float32")
+        # bias region semantics (reference init.FusedRNN: biases zeroed,
+        # LSTM forget-gate bias = forget_bias so gates start open). The
+        # packed layout puts all biases LAST: per layer per direction,
+        # bi then bh, each `gates*h` long (ops/rnn_fused.py
+        # rnn_param_size/_unpack_params); gate order i,f,g,o.
+        kw = self._kwargs
+        h = int(kw.get("num_hidden") or 0)
+        layers = int(kw.get("num_layers") or 0)
+        mode = kw.get("mode", "lstm")
+        dirs = 2 if kw.get("bidirectional") else 1
+        gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}.get(
+            mode, 0)
+        bias_total = layers * dirs * gates * h * 2
+        if h and layers and gates and bias_total <= int(np.prod(arr.shape)):
+            biases = np.zeros((2 * layers * dirs, gates * h), np.float32)
+            if mode == "lstm" and self.forget_bias:
+                biases[0::2, h:2 * h] = self.forget_bias  # bi rows only
+            v = np.array(arr.asnumpy(), copy=True).reshape(-1)
+            v[-bias_total:] = biases.reshape(-1)
+            arr[:] = v.reshape(arr.shape)
 
 
 class Mixed:

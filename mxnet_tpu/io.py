@@ -439,7 +439,14 @@ class DevicePrefetchIter(DataIter):
                 except StopIteration:
                     q.put(None)
                     return
-                q.put(self._place(batch))
+                except BaseException as e:  # surface in the consumer —
+                    q.put(e)                # a silent death would hang next()
+                    return
+                try:
+                    q.put(self._place(batch))
+                except BaseException as e:
+                    q.put(e)
+                    return
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
@@ -480,6 +487,9 @@ class DevicePrefetchIter(DataIter):
         if batch is None:
             self._done = True
             raise StopIteration
+        if isinstance(batch, BaseException):
+            self._done = True
+            raise batch
         return batch
 
     def close(self):

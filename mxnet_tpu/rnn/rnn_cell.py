@@ -319,8 +319,18 @@ class FusedRNNCell(BaseRNNCell):
         self._dropout = dropout
         self._get_next_state = get_next_state
         self._directions = 2 if bidirectional else 1
-        initializer = None
-        self._parameter = self.params.get("parameters")
+        # tag the packed blob with the FusedRNN initializer so generic
+        # initializers (Xavier etc.) route through it — the reference does
+        # exactly this (rnn_cell.py FusedRNNCell: params.get('parameters',
+        # init=init.FusedRNN(None, ...)))
+        from .. import initializer as _init
+
+        self._parameter = self.params.get(
+            "parameters",
+            init=_init.FusedRNN(None, num_hidden=num_hidden,
+                                num_layers=num_layers, mode=mode,
+                                bidirectional=bidirectional,
+                                forget_bias=forget_bias))
 
     @property
     def state_info(self):

@@ -126,3 +126,92 @@ def test_rnn_op_forward_shapes():
     out = nd.RNN(x, params, state, state_size=H, num_layers=2, mode="gru")
     first = out[0] if isinstance(out, (list, tuple)) else out
     assert first.shape == (T, B, H)
+
+
+def test_pallas_lstm_fast_path_selection():
+    """The Pallas LSTM step must be SELECTED on TPU for qualifying shapes
+    and produce the same math as the plain scan (the cudnn-autotune-
+    registry contract, cudnn_algoreg-inl.h). On the CPU suite the kernel
+    runs in interpret mode via monkeypatching the gate."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import pallas as pallas_pkg
+    from mxnet_tpu.ops import rnn_fused
+    from mxnet_tpu.ops.pallas import lstm as pl_lstm
+
+    # selection gate: qualifies on TPU shapes, rejects misaligned ones
+    # (use_for resolves on_tpu from the package at call time)
+    orig_on_tpu = pallas_pkg.on_tpu
+    try:
+        pallas_pkg.on_tpu = lambda: True
+        assert pl_lstm.use_for(32, 256)       # aligned
+        assert not pl_lstm.use_for(32, 200)   # hidden not lane-aligned
+        assert not pl_lstm.use_for(3, 256)    # batch not sublane-aligned
+        pallas_pkg.on_tpu = lambda: False
+        assert not pl_lstm.use_for(32, 256)   # never off-TPU
+    finally:
+        pallas_pkg.on_tpu = orig_on_tpu
+
+    # numeric equivalence: interpret-mode pallas vs plain scan
+    rng = np.random.RandomState(5)
+    N, H, T = 8, 128, 4
+    ib = jnp.asarray(rng.randn(T, N, 4 * H).astype(np.float32) * 0.3)
+    h0 = jnp.asarray(rng.randn(N, H).astype(np.float32) * 0.3)
+    c0 = jnp.asarray(rng.randn(N, H).astype(np.float32) * 0.3)
+    wh = jnp.asarray(rng.randn(4 * H, H).astype(np.float32) * 0.3)
+
+    orig_step = pl_lstm.lstm_step
+    try:
+        pl_lstm.lstm_step = lambda *a, **kw: orig_step(*a, interpret=True)
+        (h_f, c_f), ys_f = rnn_fused._lstm_scan_fused(ib, h0, c0, wh)
+    finally:
+        pl_lstm.lstm_step = orig_step
+    (h_p, c_p), ys_p = rnn_fused._lstm_scan_jnp(ib, h0, c0, wh, H)
+    np.testing.assert_allclose(np.asarray(ys_f), np.asarray(ys_p),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c_f), np.asarray(c_p),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_rnn_cell_initializes_with_generic_initializer():
+    """FusedRNNCell's packed parameter blob carries the FusedRNN
+    initializer attr, so Module.init_params(Xavier()) works (reference
+    rnn_cell.py FusedRNNCell + init.FusedRNN)."""
+    import mxnet_tpu as mx
+
+    cell = mx.rnn.FusedRNNCell(128, num_layers=1, mode="lstm",
+                               prefix="lstm_")
+    data = mx.sym.Variable("data")
+    outputs, _ = cell.unroll(4, inputs=data, merge_outputs=True,
+                             layout="NTC")
+    pred = mx.sym.FullyConnected(mx.sym.Reshape(outputs, shape=(-1, 128)),
+                                 num_hidden=4, name="pred")
+    net = mx.sym.SoftmaxOutput(pred, name="softmax")
+    mod = mx.mod.Module(net, data_names=("data",))
+    mod.bind(data_shapes=[("data", (2, 4, 8))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.initializer.Xavier())  # must not raise
+    params = mod.get_params()[0]
+    blob = params["lstm_parameters"].asnumpy()
+    assert np.abs(blob).max() > 0  # actually initialized
+
+
+def test_fused_rnn_initializer_forget_bias():
+    """FusedRNN initializer: bias region zeroed, LSTM forget-gate bias
+    slices = forget_bias (reference init.FusedRNN semantics)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.ops import rnn_fused
+
+    H, L, NI = 16, 2, 8
+    size = rnn_fused.rnn_param_size(L, NI, H, "lstm")
+    arr = mx.nd.zeros((size,))
+    init = mx.initializer.FusedRNN(None, num_hidden=H, num_layers=L,
+                                   mode="lstm", forget_bias=2.0)
+    init(mx.initializer.InitDesc("lstm_parameters"), arr)
+    v = arr.asnumpy()
+    bias_total = L * 4 * H * 2
+    weights, biases = v[:-bias_total], v[-bias_total:].reshape(2 * L, 4 * H)
+    assert np.abs(weights).max() > 0  # weights initialized
+    # bi rows: forget slice = 2.0, other gates zero; bh rows: all zero
+    np.testing.assert_allclose(biases[0::2, H:2 * H], 2.0)
+    np.testing.assert_allclose(biases[0::2, :H], 0.0)
+    np.testing.assert_allclose(biases[1::2], 0.0)
