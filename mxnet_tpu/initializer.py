@@ -59,9 +59,6 @@ class Initializer:
             self._init_beta(desc, arr)
         elif name.endswith("weight"):
             self._init_weight(desc, arr)
-        elif name.endswith("parameters"):
-            # fused-RNN packed blobs (FusedRNN initializer routes here)
-            self._init_weight(desc, arr)
         elif name.endswith("moving_mean") or name.endswith("running_mean"):
             self._init_zero(desc, arr)
         elif name.endswith("moving_var") or name.endswith("running_var"):
@@ -248,17 +245,19 @@ class FusedRNN(Initializer):
         self._init = init
         self.forget_bias = forget_bias
 
+    def __call__(self, desc, arr):
+        # packed blobs bypass the suffix dispatch entirely — this
+        # initializer IS the handler for 'parameters' names
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(desc)
+        self._init_weight(desc, arr)
+
     def _init_weight(self, desc, arr):
-        if self._init is not None:
-            self._init._init_weight(desc, arr)
-        else:
-            arr[:] = np.random.uniform(-0.07, 0.07, arr.shape).astype(
-                "float32")
-        # bias region semantics (reference init.FusedRNN: biases zeroed,
-        # LSTM forget-gate bias = forget_bias so gates start open). The
-        # packed layout puts all biases LAST: per layer per direction,
-        # bi then bh, each `gates*h` long (ops/rnn_fused.py
-        # rnn_param_size/_unpack_params); gate order i,f,g,o.
+        """Per-matrix initialization of the packed blob (the reference
+        unpacks, applies the inner init per weight matrix, then repacks).
+        Packed layout (ops/rnn_fused.py rnn_param_size/_unpack_params):
+        per layer per direction wi then wh, then ALL biases (bi, bh per
+        layer/dir, each gates*h; gate order i,f,g,o)."""
         kw = self._kwargs
         h = int(kw.get("num_hidden") or 0)
         layers = int(kw.get("num_layers") or 0)
@@ -266,14 +265,48 @@ class FusedRNN(Initializer):
         dirs = 2 if kw.get("bidirectional") else 1
         gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}.get(
             mode, 0)
+        total = int(np.prod(arr.shape))
         bias_total = layers * dirs * gates * h * 2
-        if h and layers and gates and bias_total <= int(np.prod(arr.shape)):
-            biases = np.zeros((2 * layers * dirs, gates * h), np.float32)
-            if mode == "lstm" and self.forget_bias:
-                biases[0::2, h:2 * h] = self.forget_bias  # bi rows only
-            v = np.array(arr.asnumpy(), copy=True).reshape(-1)
-            v[-bias_total:] = biases.reshape(-1)
-            arr[:] = v.reshape(arr.shape)
+
+        def fill(mat_shape, name):
+            out = np.empty(mat_shape, np.float32)
+            if self._init is not None:
+                from . import ndarray as nd
+
+                buf = nd.zeros(mat_shape)
+                self._init._init_weight(InitDesc(name), buf)
+                out[:] = buf.asnumpy()
+            else:
+                out[:] = np.random.uniform(-0.07, 0.07, mat_shape)
+            return out
+
+        if not (h and layers and gates and bias_total < total):
+            # unknown layout: fall back to whole-blob fill
+            arr[:] = fill((total,), str(desc)).reshape(arr.shape)
+            return
+        # recover the input size from the blob length
+        w_total = total - bias_total
+        per_upper = dirs * gates * h * (dirs * h + h)  # layers > 0
+        ni = (w_total - (layers - 1) * per_upper) // (dirs * gates * h) - h
+        v = np.empty(total, np.float32)
+        p = 0
+        for layer in range(layers):
+            in_sz = ni if layer == 0 else h * dirs
+            for d in range(dirs):
+                n_wi = gates * h * in_sz
+                v[p:p + n_wi] = fill((gates * h, in_sz),
+                                     "%s_l%d_wi" % (desc, layer)).reshape(-1)
+                p += n_wi
+                n_wh = gates * h * h
+                v[p:p + n_wh] = fill((gates * h, h),
+                                     "%s_l%d_wh" % (desc, layer)).reshape(-1)
+                p += n_wh
+        # biases zeroed; LSTM forget-gate slice of each bi = forget_bias
+        biases = np.zeros((2 * layers * dirs, gates * h), np.float32)
+        if mode == "lstm" and self.forget_bias:
+            biases[0::2, h:2 * h] = self.forget_bias  # bi rows only
+        v[p:] = biases.reshape(-1)
+        arr[:] = v.reshape(arr.shape)
 
 
 class Mixed:

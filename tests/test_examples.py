@@ -48,6 +48,37 @@ def test_ssd_train_example():
     assert "decreasing" in out and "NOT decreasing" not in out
 
 
+def test_rcnn_train_example():
+    """RPN training end-to-end: anchor assignment -> ignore-aware softmax
+    + masked smooth-L1 -> loss decreasing."""
+    out = _run("examples/rcnn/train.py", "--steps", "12")
+    assert "decreasing" in out and "NOT decreasing" not in out
+
+
+def test_autoencoder_example():
+    out = _run("examples/autoencoder/train.py", "--epochs", "10")
+    assert "autoencoder OK" in out
+
+
+def test_multi_task_example():
+    out = _run("examples/multi-task/train.py", "--epochs", "8")
+    assert "multi-task OK" in out
+
+
+def test_adversary_fgsm_example():
+    out = _run("examples/adversary/fgsm.py")
+    assert "fgsm OK" in out
+
+
+def test_bench_lstm_example():
+    """Pallas-selection microbench + PTB LM throughput paths, incl. the
+    scalar-loss head symbol."""
+    out = _run("examples/rnn/bench_lstm.py", "--steps", "3",
+               "--batch-size", "8", "--num-hidden", "64", "--vocab", "200",
+               "--seq-len", "8", "--loss-head")
+    assert "ptb-lm(loss-head)" in out and "micro" in out
+
+
 def test_benchmark_score_example():
     out = _run("examples/image-classification/benchmark_score.py",
                "--networks", "mlp", "--batch-sizes", "4", "--iters", "3",
