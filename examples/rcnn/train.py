@@ -3,7 +3,7 @@
 
 Analogue of the reference's example/rcnn training stage 1 (RPN): a conv
 backbone feeds 1x1 cls/bbox heads; anchor targets are assigned by IoU
-(positive > 0.7 or best, negative < 0.3, rest ignored), cls trains with
+(positive IoU >= 0.5 or best-match, negative < 0.3, rest ignored), cls trains with
 SoftmaxOutput(use_ignore, multi_output) and bbox regression with
 masked smooth-L1 MakeLoss — the same loss structure the reference wires
 in example/rcnn/rcnn/symbol. Runs a few steps on synthetic one-box
@@ -147,14 +147,16 @@ def main():
                           np.float32)
             imgs[b, :, int(gt[1]):int(gt[3]), int(gt[0]):int(gt[2])] += 1.0
             lab, tgt = assign_targets(anchors, gt, S, rng=rng)
-            # anchors enumerate (position, anchor) = (F*F, A) blocks; the
-            # head's channel layout is (A, F*F) — transpose to match
+            # anchors enumerate (position, anchor) = (F*F, A); the cls
+            # head flattens as (A, F*F) and the bbox head as
+            # (A, 4, F*F) (conv channels are a*4+coord) — match both
             lab2 = lab.reshape(F * F, A).T.reshape(-1)
-            tgt2 = tgt.reshape(F * F, A, 4).transpose(1, 0, 2)
+            tgt2 = tgt.reshape(F * F, A, 4).transpose(1, 2, 0)  # (A,4,F*F)
             labels[b] = lab2
             targets[b] = tgt2.reshape(-1)
-            m = (lab2 == 1).astype(np.float32)
-            masks[b] = np.repeat(m, 4)
+            m = (lab == 1).astype(np.float32).reshape(F * F, A).T  # (A,F*F)
+            masks[b] = np.repeat(m.reshape(A, 1, F * F), 4,
+                                 axis=1).reshape(-1)
         return mx.io.DataBatch(
             [mx.nd.array(imgs)],
             [mx.nd.array(labels), mx.nd.array(targets), mx.nd.array(masks)])

@@ -46,7 +46,14 @@ class Initializer:
         init = desc.attrs.get("__init__", "")
         if init:
             klass, kwargs = json.loads(init)
-            init_registry[klass.lower()](**kwargs)._init_weight(desc, arr)
+            inst = init_registry[klass.lower()](**kwargs)
+            # full suffix dispatch of the attr-selected initializer (a
+            # 'parameters' blob must hit its _init_parameters, not
+            # _init_weight); strip the attr to avoid recursion
+            clean = InitDesc(str(desc),
+                             {k: v for k, v in desc.attrs.items()
+                              if k != "__init__"}, desc.global_init)
+            inst(clean, arr)
             return
         name = desc.lower()
         if name.endswith("upsampling"):
@@ -59,6 +66,8 @@ class Initializer:
             self._init_beta(desc, arr)
         elif name.endswith("weight"):
             self._init_weight(desc, arr)
+        elif name.endswith("parameters"):
+            self._init_parameters(desc, arr)
         elif name.endswith("moving_mean") or name.endswith("running_mean"):
             self._init_zero(desc, arr)
         elif name.endswith("moving_var") or name.endswith("running_var"):
@@ -96,6 +105,14 @@ class Initializer:
 
     def _init_weight(self, name, arr):
         raise NotImplementedError()
+
+    def _init_parameters(self, name, arr):
+        """Packed fused-RNN blobs ('..._parameters'). Generic initializers
+        fall back to a small uniform fill (shape-dependent rules like
+        Xavier cannot see the per-matrix structure of a flat blob); use
+        initializer.FusedRNN for per-matrix init + forget-bias semantics."""
+        arr[:] = np.random.uniform(-0.07, 0.07, arr.shape).astype(
+            "float32")
 
     def _init_default(self, name, arr):
         raise MXNetError(
@@ -245,14 +262,16 @@ class FusedRNN(Initializer):
         self._init = init
         self.forget_bias = forget_bias
 
-    def __call__(self, desc, arr):
-        # packed blobs bypass the suffix dispatch entirely — this
-        # initializer IS the handler for 'parameters' names
-        if not isinstance(desc, InitDesc):
-            desc = InitDesc(desc)
-        self._init_weight(desc, arr)
-
     def _init_weight(self, desc, arr):
+        # non-blob weights (mixed nets initialized wholesale with
+        # FusedRNN): delegate to the inner init
+        if self._init is not None:
+            self._init._init_weight(desc, arr)
+        else:
+            arr[:] = np.random.uniform(-0.07, 0.07, arr.shape).astype(
+                "float32")
+
+    def _init_parameters(self, desc, arr):
         """Per-matrix initialization of the packed blob (the reference
         unpacks, applies the inner init per weight matrix, then repacks).
         Packed layout (ops/rnn_fused.py rnn_param_size/_unpack_params):

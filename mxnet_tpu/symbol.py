@@ -399,11 +399,33 @@ class Symbol:
                         compute_dtype=compute_dtype)
 
     # --- evaluation helper used by Executor -------------------------------
-    def build_eval(self):
+    def build_eval(self, remat_segments=None):
         """Return fn(arg_values: dict, aux_values: dict, is_train, rng)
-        -> (outputs list, aux_updates dict). Pure; jit-able."""
+        -> (outputs list, aux_updates dict). Pure; jit-able.
+
+        remat_segments > 1 partitions the graph into that many contiguous
+        topological segments, each wrapped in ``jax.checkpoint``: backward
+        keeps only segment-boundary activations and recomputes segment
+        interiors — the reference's MXNET_BACKWARD_DO_MIRROR /
+        note_memory.md "memonger" memory-for-FLOPs trade
+        (graph_executor.cc:213-226), realized the TPU way. ``None`` reads
+        the MXNET_BACKWARD_DO_MIRROR env var (1 = auto ≈ sqrt(#ops),
+        k>1 = exactly k segments)."""
         nodes = self._nodes()
         entries = self._entries
+        if remat_segments is None:
+            import builtins
+            import math
+            import os as _os
+
+            flag = int(_os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0") or 0)
+            # `sum`/`max` here are generated op functions, not builtins
+            n_ops = builtins.sum(1 for n in nodes if not n.is_var)
+            remat_segments = (builtins.max(2, int(math.sqrt(n_ops)))
+                              if flag == 1 else flag)
+        if remat_segments and remat_segments > 1:
+            return self._build_eval_segmented(nodes, entries,
+                                              int(remat_segments))
 
         def eval_fn(arg_values, aux_values, is_train, rng):
             env: Dict[Tuple[int, int], Any] = {}
@@ -432,6 +454,102 @@ class Symbol:
                 for (child, _), new in zip(node.inputs[n_args:], aux_out):
                     if child.is_var:
                         aux_updates[child.name] = new
+            outputs = [env[(id(n), i)] for n, i in entries]
+            return outputs, aux_updates
+
+        return eval_fn
+
+    def _build_eval_segmented(self, nodes, entries, n_segments):
+        """Segmented evaluator: contiguous topo chunks, each under
+        jax.checkpoint; only chunk-boundary values are saved for backward."""
+        import math
+
+        import builtins
+
+        op_nodes = [(ni, n) for ni, n in enumerate(nodes) if not n.is_var]
+        # `min`/`max`/`sum` are generated op functions in this namespace
+        k = builtins.max(1, builtins.min(n_segments, len(op_nodes)))
+        per = math.ceil(len(op_nodes) / k)
+        chunks = [op_nodes[i * per:(i + 1) * per]
+                  for i in range(k) if op_nodes[i * per:(i + 1) * per]]
+        final_keys = {(id(n), i) for n, i in entries}
+        # per-chunk: which produced entries must leave the chunk (consumed
+        # by a LATER chunk or part of the final outputs)
+        out_keys = []
+        for ci, chunk in enumerate(chunks):
+            produced = {(id(n), i) for _, n in chunk
+                        for i in range(n.op.get_num_outputs(n.attrs))}
+            needed = set()
+            for cj in range(ci + 1, len(chunks)):
+                for _, n in chunks[cj]:
+                    for c, i in n.inputs:
+                        if (id(c), i) in produced:
+                            needed.add((id(c), i))
+            needed |= produced & final_keys
+            out_keys.append(sorted(needed, key=lambda t: (t[0], t[1])))
+        in_keys = []
+        for ci, chunk in enumerate(chunks):
+            produced = {(id(n), i) for _, n in chunk
+                        for i in range(n.op.get_num_outputs(n.attrs))}
+            needed = {(id(c), i) for _, n in chunk for c, i in n.inputs
+                      if (id(c), i) not in produced}
+            in_keys.append(sorted(needed, key=lambda t: (t[0], t[1])))
+
+        def eval_fn(arg_values, aux_values, is_train, rng):
+            env: Dict[Tuple[int, int], Any] = {}
+            aux_updates: Dict[str, Any] = {}
+            for node in nodes:
+                if node.is_var:
+                    src = aux_values if node.is_aux else arg_values
+                    if node.name not in src:
+                        raise MXNetError("missing value for %s" % node.name)
+                    env[(id(node), 0)] = src[node.name]
+
+            for ci, chunk in enumerate(chunks):
+                ikeys, okeys = in_keys[ci], out_keys[ci]
+
+                def chunk_fn(in_vals, c_rng, _chunk=chunk, _ik=ikeys,
+                             _ok=okeys):
+                    local = dict(zip(_ik, in_vals))
+                    aux_out_items = []
+                    for ni, node in _chunk:
+                        op, attrs = node.op, node.attrs
+                        vals = [local[(id(c), i)] for c, i in node.inputs]
+                        n_aux = (len(op.get_aux_names(attrs))
+                                 if not op.variadic else 0)
+                        n_args = len(vals) - n_aux
+                        node_rng = (jax.random.fold_in(c_rng, ni)
+                                    if op.needs_rng else None)
+                        outs, aux_out = op.impl(
+                            attrs, tuple(vals[:n_args]), tuple(vals[n_args:]),
+                            OpContext(is_train, node_rng))
+                        for i, o in enumerate(outs):
+                            local[(id(node), i)] = o
+                        for (child, _), new in zip(node.inputs[n_args:],
+                                                   aux_out):
+                            if child.is_var:
+                                aux_out_items.append((child.name, new))
+                    return (tuple(local[kk] for kk in _ok),
+                            tuple(v for _, v in aux_out_items))
+
+                aux_names_chunk = []
+                for ni, node in chunk:
+                    op, attrs = node.op, node.attrs
+                    n_aux = (len(op.get_aux_names(attrs))
+                             if not op.variadic else 0)
+                    if n_aux:
+                        for child, _ in node.inputs[-n_aux:]:
+                            if child.is_var:
+                                aux_names_chunk.append(child.name)
+                # last chunk needs no checkpoint: its residuals are the
+                # final outputs anyway
+                fn = (jax.checkpoint(chunk_fn)
+                      if ci < len(chunks) - 1 else chunk_fn)
+                in_vals = tuple(env[kk] for kk in ikeys)
+                out_vals, aux_vals = fn(in_vals, rng)
+                env.update(zip(okeys, out_vals))
+                aux_updates.update(zip(aux_names_chunk, aux_vals))
+
             outputs = [env[(id(n), i)] for n, i in entries]
             return outputs, aux_updates
 
