@@ -210,6 +210,63 @@ class Symbol:
     def __neg__(self):
         return self._binop(-1.0, "broadcast_mul", "_mul_scalar")
 
+    def grad(self, wrt):
+        """Gradient symbol wrt the named arguments (reference symbol.py:
+        1374-1397 documents this API but its C implementation is a stub —
+        'currently not implemented'; jax.vjp makes it real here).
+
+        Returns a Symbol with one output per name in ``wrt``: the gradient
+        of the SUM of this symbol's outputs with respect to that argument.
+        The gradient symbol takes the same arguments (and aux states) as
+        ``self``."""
+        from .ops.registry import OpDef, register_op
+
+        wrt = [wrt] if isinstance(wrt, str) else list(wrt)
+        base = self.__copy__()
+        arg_names = base.list_arguments()
+        aux_names = base.list_auxiliary_states()
+        missing = [w for w in wrt if w not in arg_names]
+        if missing:
+            raise MXNetError("grad: unknown arguments %s (have %s)"
+                             % (missing, arg_names))
+        eval_fn = base.build_eval()
+        n_args = len(arg_names)
+
+        def impl(attrs, inputs, aux, ctx):
+            arg_values = dict(zip(arg_names, inputs))
+            aux_values = dict(zip(aux_names, aux))
+
+            import builtins
+
+            def f(g_values):
+                av = dict(arg_values)
+                av.update(g_values)
+                outs, _ = eval_fn(av, aux_values, ctx.is_train, ctx.rng)
+                # builtins.sum: `sum` is a generated op in this namespace
+                return builtins.sum(jnp.sum(o) for o in outs)
+
+            grads = jax.grad(f)({w: arg_values[w] for w in wrt})
+            return tuple(grads[w] for w in wrt), ()
+
+        gname = _name_mod.current().get(None, "grad")
+        opdef = OpDef(
+            name="_grad_%s_%d" % (gname, id(base)),
+            impl=impl,
+            arg_names=tuple(arg_names),
+            aux_names=tuple(aux_names),
+            num_outputs=len(wrt),
+            output_names=tuple("%s_grad" % w for w in wrt),
+            needs_rng=True,
+            uses_train=True,
+            doc="Gradient of %r wrt %s (Symbol.grad)" % (gname, wrt),
+        )
+        register_op(opdef)
+        inputs = [Variable(n) for n in arg_names]
+        for n in aux_names:  # aux slots need is_aux variable nodes
+            inputs.append(Symbol([(_Node(None, n, {}, [], is_aux=True), 0)]))
+        return _create_symbol(opdef, inputs, {}, gname,
+                              input_names=arg_names + aux_names)
+
     # --- inference --------------------------------------------------------
     def infer_shape(self, *args, **kwargs):
         res = self._infer(kwargs, partial=False)

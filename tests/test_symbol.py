@@ -108,3 +108,25 @@ def test_variable_shape_hint():
     arg_shapes, out_shapes, _ = f.infer_shape()
     assert arg_shapes[0] == (4, 5)
     assert out_shapes[0] == ()
+
+
+def test_symbol_grad():
+    """Symbol.grad: the reference documents this API but stubs it
+    ('currently not implemented', symbol.py:1374); here it returns a real
+    gradient symbol."""
+    import numpy as np
+    from mxnet_tpu.test_utils import _bind
+
+    x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    w = np.random.RandomState(1).rand(3, 4).astype(np.float32)
+    s = sym.sum(sym._mul(sym.Variable("w"), sym.square(sym.Variable("x"))))
+    g = s.grad(["x", "w"])
+    assert set(g.list_arguments()) == {"x", "w"}
+    exe = _bind(g, {"x": x, "w": w}, None, "null", None)
+    outs = exe.forward()
+    np.testing.assert_allclose(outs[0].asnumpy(), 2 * w * x, rtol=1e-5)
+    np.testing.assert_allclose(outs[1].asnumpy(), x * x, rtol=1e-5)
+    # aux states survive (BN moving stats)
+    net = sym.sum(sym.BatchNorm(sym.Variable("data"), name="bn"))
+    g2 = net.grad(["data"])
+    assert g2.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
