@@ -300,8 +300,16 @@ class FusedRNN(Initializer):
             return out
 
         if not (h and layers and gates and bias_total < total):
-            # unknown layout: fall back to whole-blob fill
-            arr[:] = fill((total,), str(desc)).reshape(arr.shape)
+            # Unknown layout: shape-INdependent inner inits (Uniform/Normal/
+            # Constant) still apply fine to the flat blob; shape-dependent
+            # ones (Xavier/Orthogonal/Bilinear) assume >=2 dims and would
+            # raise or produce degenerate scales on (total,), so those fall
+            # back to the plain uniform fill instead.
+            if isinstance(self._init, (Uniform, Normal, Constant, Zero, One)):
+                arr[:] = fill((total,), str(desc)).reshape(arr.shape)
+            else:
+                arr[:] = np.random.uniform(-0.07, 0.07,
+                                           (total,)).reshape(arr.shape)
             return
         # recover the input size from the blob length
         w_total = total - bias_total

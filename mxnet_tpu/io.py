@@ -391,6 +391,7 @@ class DevicePrefetchIter(DataIter):
         self._lock = threading.Lock()
         self._thread = None
         self._done = False
+        self._wedged = False  # worker failed to join: refuse base reuse
         self._start()
 
     def _device(self):
@@ -472,8 +473,21 @@ class DevicePrefetchIter(DataIter):
             pass
         t = self._thread
         if t is not None and t.is_alive():
-            t.join(timeout=60)
+            # once wedged, re-join briefly instead of another full 60s wait
+            t.join(timeout=5 if self._wedged else 60)
+            if t.is_alive():
+                # Worker stuck past the timeout (e.g. wedged device
+                # transfer): touching the non-thread-safe base iterator now
+                # would race with it. Keep the reference but mark the
+                # iterator wedged so repeated reset()/close() keep refusing
+                # (with a short re-join, not another full 60s).
+                self._wedged = True
+                raise RuntimeError(
+                    "DevicePrefetchIter: worker thread did not exit within "
+                    "60s; refusing to reuse the base iterator while it may "
+                    "still be reading it")
         self._thread = None
+        self._wedged = False
 
     def reset(self):
         self._retire_worker()

@@ -87,8 +87,11 @@ class ImageRecordIter(DataIter):
             data, labels, n = out
         pad = self.batch_size - n
         if pad and not self._round_batch:
+            # physically truncated: every remaining row is real, so pad=0
+            # (consumers strip the last `pad` rows — see base_module.predict)
             data = data[:n]
             labels = labels[:n]
+            pad = 0
         return DataBatch([nd.array(data.copy())], [nd.array(labels.copy())],
                          pad=pad)
 
@@ -332,6 +335,7 @@ class ImageDetRecordIter(DataIter):
             aug_list = CreateDetAugmenter(self.data_shape, mean=mean, std=std,
                                           **aug_kwargs)
         self.det_auglist = aug_list
+        self._epoch_done = False
 
     @property
     def provide_data(self):
@@ -343,6 +347,7 @@ class ImageDetRecordIter(DataIter):
 
     def reset(self):
         self._reader.reset()
+        self._epoch_done = False
 
     def next(self):
         import cv2
@@ -350,14 +355,27 @@ class ImageDetRecordIter(DataIter):
         from . import recordio
         from .image import parse_det_label
 
+        if self._epoch_done:
+            raise StopIteration
         c, h, w = self.data_shape
         data = np.zeros((self.batch_size, c, h, w), np.float32)
         label = np.full((self.batch_size, self.max_objs, self.object_width),
                         self.label_pad_value, np.float32)
         n = 0
+        n_real = None  # real (non-wrapped) rows; set when the epoch ends mid-batch
         while n < self.batch_size:
             buf = self._reader.read()
             if buf is None:
+                # round_batch (reference ImageDetRecordIter): pad the short
+                # final batch with records wrapped from the epoch start, not
+                # zero images. Wrap at most once per batch.
+                if self._round_batch and n > 0 and n_real is None:
+                    # wrapping consumes records from the next pass purely as
+                    # padding: this batch ends the epoch
+                    self._reader.reset()
+                    self._epoch_done = True
+                    n_real = n
+                    continue
                 break
             header, img_bytes = recordio.unpack(buf)
             img = cv2.imdecode(np.frombuffer(img_bytes, np.uint8),
@@ -379,10 +397,15 @@ class ImageDetRecordIter(DataIter):
             n += 1
         if n == 0:
             raise StopIteration
-        pad = self.batch_size - n
-        if pad and not self._round_batch:
+        # pad counts non-real rows IN THE EMITTED BATCH: wrapped records
+        # (round_batch=True). A physically truncated batch
+        # (round_batch=False) has only real rows, so pad=0.
+        if n < self.batch_size and not self._round_batch:
             data = data[:n]
             label = label[:n]
+            pad = 0
+        else:
+            pad = self.batch_size - (n_real if n_real is not None else n)
         return DataBatch([nd.array(data)], [nd.array(label)], pad=pad)
 
 

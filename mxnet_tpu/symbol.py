@@ -26,6 +26,9 @@ from . import attribute, name as _name_mod
 from .base import MXNetError
 from .ops import OP_REGISTRY, OpContext, OpDef, get_op
 
+# Monotonic id for ephemeral Symbol.grad ops (never reused, unlike id()).
+_GRAD_OP_COUNTER = 0
+
 
 class _Node:
     __slots__ = ("op", "name", "attrs", "inputs", "is_aux", "misc_attrs")
@@ -219,7 +222,7 @@ class Symbol:
         of the SUM of this symbol's outputs with respect to that argument.
         The gradient symbol takes the same arguments (and aux states) as
         ``self``."""
-        from .ops.registry import OpDef, register_op
+        from .ops.registry import OpDef
 
         wrt = [wrt] if isinstance(wrt, str) else list(wrt)
         base = self.__copy__()
@@ -249,8 +252,14 @@ class Symbol:
             return tuple(grads[w] for w in wrt), ()
 
         gname = _name_mod.current().get(None, "grad")
+        # Ephemeral op: NOT registered in the global OP_REGISTRY (symbol
+        # nodes hold the OpDef object directly; registering would grow the
+        # registry unboundedly and id()-based names can collide after GC).
+        # Consequence: grad symbols cannot round-trip through tojson/load.
+        global _GRAD_OP_COUNTER
+        _GRAD_OP_COUNTER += 1
         opdef = OpDef(
-            name="_grad_%s_%d" % (gname, id(base)),
+            name="_grad_%s_%d" % (gname, _GRAD_OP_COUNTER),
             impl=impl,
             arg_names=tuple(arg_names),
             aux_names=tuple(aux_names),
@@ -258,9 +267,9 @@ class Symbol:
             output_names=tuple("%s_grad" % w for w in wrt),
             needs_rng=True,
             uses_train=True,
-            doc="Gradient of %r wrt %s (Symbol.grad)" % (gname, wrt),
+            doc="Gradient of %r wrt %s (Symbol.grad; ephemeral op, "
+                "not serializable via tojson/load)" % (gname, wrt),
         )
-        register_op(opdef)
         inputs = [Variable(n) for n in arg_names]
         for n in aux_names:  # aux slots need is_aux variable nodes
             inputs.append(Symbol([(_Node(None, n, {}, [], is_aux=True), 0)]))

@@ -70,9 +70,42 @@ def launch_local(n, cmd, env_extra=None, n_servers=0):
             env["MXNET_TPU_NUM_WORKERS"] = str(n)
         procs.append(subprocess.Popen(cmd, env=env))
     rc = 0
-    for p in procs:
-        p.wait()
-        rc = rc or p.returncode
+    # Port pre-allocation above is bind-then-close, so another process can
+    # steal a port before the server binds it (TOCTOU). Rather than letting
+    # the group hang on 60s connect retries, fail fast: a server exiting
+    # while workers still run means it never came up.
+    import time
+
+    running = list(procs)
+    while running:
+        for p in list(running):
+            if p.poll() is not None:
+                running.remove(p)
+                rc = rc or p.returncode
+        for s in (servers if running else ()):
+            # rc 0 is a clean stop_server() exit (stragglers may still be
+            # finishing); nonzero while workers run means the server never
+            # came up (e.g. lost its pre-allocated port to a bind race).
+            # Skipped once all workers are reaped: a server dying during
+            # shutdown must not fail a successful job.
+            if s.poll() is not None and s.returncode != 0:
+                sys.stderr.write(
+                    "launch.py: server process exited early (rc=%s) while "
+                    "workers are running — likely lost its pre-allocated "
+                    "port; killing the group\n" % s.returncode)
+                for p in running + [x for x in servers if x.poll() is None]:
+                    p.terminate()
+                for p in running + servers:
+                    try:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                        p.wait()
+                # a worker failure already recorded in rc stays the verdict
+                # (workers define success); the server rc is the fallback
+                return rc or s.returncode or 1
+        if running:
+            time.sleep(0.2)
     # servers only exit on a kv.stop_server() RPC; whether or not the
     # workers sent one, shut the group down now. Server exit status does
     # NOT fold into the launcher rc — workers define success (the
