@@ -86,6 +86,77 @@ def random_crop(src, size, interp=2):
     return out, (x0, y0, new_w, new_h)
 
 
+def random_size_crop(src, size, min_area, ratio, interp=2):
+    """Random crop with random area and aspect ratio (reference image.py
+    random_size_crop; falls back to random_crop when the ratio draw leaves
+    no admissible area)."""
+    arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    h, w = arr.shape[:2]
+    new_ratio = pyrandom.uniform(*ratio)
+    if new_ratio * h > w:
+        max_area = w * int(w / new_ratio)
+    else:
+        max_area = h * int(h * new_ratio)
+    min_area = min_area * h * w
+    if max_area < min_area:
+        return random_crop(src, size, interp)
+    new_area = pyrandom.uniform(min_area, max_area)
+    new_w = int(np.sqrt(new_area * new_ratio))
+    new_h = int(np.sqrt(new_area / new_ratio))
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def _rotate_arr(arr, angle, fill_value=255, interp=1):
+    """numpy-in/numpy-out body of rotate_image (shared with the host data
+    loaders, which must stay off the device)."""
+    import cv2
+
+    h, w = arr.shape[:2]
+    a = np.cos(angle / 180.0 * np.pi)
+    b = np.sin(angle / 180.0 * np.pi)
+    M = np.zeros((2, 3), np.float32)
+    M[0, 0], M[0, 1] = a, b
+    M[1, 0], M[1, 1] = -b, a
+    M[0, 2] = (w - (M[0, 0] * w + M[0, 1] * h)) / 2
+    M[1, 2] = (h - (M[1, 0] * w + M[1, 1] * h)) / 2
+    return cv2.warpAffine(arr, M, (w, h), flags=interp,
+                          borderMode=cv2.BORDER_CONSTANT,
+                          borderValue=(fill_value,) * 3)
+
+
+def rotate_image(src, angle, fill_value=255, interp=1):
+    """Rotate by ``angle`` degrees about the center, same output size,
+    constant fill — the reference affine at scale=1/shear=0/aspect=1
+    (src/io/image_aug_default.cc:215-246: M=[[cos,sin],[-sin,cos]] with the
+    translation that centers the rotated image)."""
+    arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    return nd.array(_rotate_arr(arr, angle, fill_value, interp))
+
+
+def _hsl_arr(arr, dh, ds, dl):
+    """numpy-in/numpy-out body of hsl_shift (shared with the host data
+    loaders)."""
+    import cv2
+
+    hls = cv2.cvtColor(arr.astype(np.uint8), cv2.COLOR_RGB2HLS).astype(np.int32)
+    shifted = hls + np.array([dh, dl, ds], np.int32)
+    limit = np.array([180, 255, 255], np.int32)
+    shifted = np.clip(shifted, 0, limit).astype(np.uint8)
+    return cv2.cvtColor(shifted, cv2.COLOR_HLS2RGB)
+
+
+def hsl_shift(src, dh, ds, dl):
+    """Add integer offsets to the H/S/L channels in 8-bit HLS space and
+    clip — the reference color-space augmentation
+    (src/io/image_aug_default.cc:297-316: per-pixel add of (h, l, s) with
+    limits (180, 255, 255)). Input and output are uint8 RGB."""
+    arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    return nd.array(_hsl_arr(arr, dh, ds, dl))
+
+
 def center_crop(src, size, interp=2):
     arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
     h, w = arr.shape[:2]
@@ -167,24 +238,179 @@ class BrightnessJitterAug(Augmenter):
         return nd.array(src.asnumpy().astype(np.float32) * alpha)
 
 
+# Rec.601 luma weights shared by contrast/saturation jitter (reference
+# image.py ColorJitterAug coef).
+_GRAY_COEF = np.array([0.299, 0.587, 0.114], np.float32)
+
+
+class ContrastJitterAug(Augmenter):
+    """src*alpha + mean_gray*(1-alpha) (reference image.py ColorJitterAug
+    contrast branch: gray = (3*(1-alpha)/size)*sum(src*coef))."""
+
+    def __init__(self, contrast):
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        arr = src.asnumpy().astype(np.float32)
+        gray = arr * _GRAY_COEF
+        gray = (3.0 * (1.0 - alpha) / gray.size) * gray.sum()
+        return nd.array(arr * alpha + gray)
+
+
+class SaturationJitterAug(Augmenter):
+    """Blend toward the per-pixel gray value (reference image.py
+    ColorJitterAug saturation branch)."""
+
+    def __init__(self, saturation):
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        arr = src.asnumpy().astype(np.float32)
+        gray = (arr * _GRAY_COEF).sum(axis=2, keepdims=True)
+        return nd.array(arr * alpha + gray * (1.0 - alpha))
+
+
+class RandomOrderAug(Augmenter):
+    """Apply child augmenters in a freshly shuffled order each call
+    (reference image.py RandomOrderAug)."""
+
+    def __init__(self, ts):
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        order = list(self.ts)
+        pyrandom.shuffle(order)
+        for t in order:
+            src = t(src)
+        return src
+
+
+def ColorJitterAug(brightness, contrast, saturation):
+    """Random brightness/contrast/saturation jitter in random order
+    (reference image.py ColorJitterAug)."""
+    ts: List[Augmenter] = []
+    if brightness > 0:
+        ts.append(BrightnessJitterAug(brightness))
+    if contrast > 0:
+        ts.append(ContrastJitterAug(contrast))
+    if saturation > 0:
+        ts.append(SaturationJitterAug(saturation))
+    return RandomOrderAug(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA-based lighting noise (reference image.py LightingAug: alpha ~
+    N(0, alphastd); src += eigvec @ (alpha * eigval))."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = np.dot(self.eigvec * alpha, self.eigval)
+        return nd.array(src.asnumpy().astype(np.float32) + rgb)
+
+
+# ImageNet PCA basis (reference image.py CreateAugmenter pca_noise block).
+PCA_EIGVAL = np.array([55.46, 4.794, 1.148])
+PCA_EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                       [-0.5808, -0.0045, -0.8140],
+                       [-0.5836, -0.6948, 0.4203]])
+
+
+class HSLJitterAug(Augmenter):
+    """Random additive jitter in 8-bit HLS space (native-path analogue:
+    src/io/image_aug_default.cc random_h/s/l). Runs on uint8 RGB, so place
+    it BEFORE CastAug in an augmenter chain."""
+
+    def __init__(self, random_h=0, random_s=0, random_l=0):
+        self.random_h = int(random_h)
+        self.random_s = int(random_s)
+        self.random_l = int(random_l)
+
+    def __call__(self, src):
+        dh = int(pyrandom.uniform(0, 1) * self.random_h * 2 - self.random_h)
+        ds = int(pyrandom.uniform(0, 1) * self.random_s * 2 - self.random_s)
+        dl = int(pyrandom.uniform(0, 1) * self.random_l * 2 - self.random_l)
+        return hsl_shift(src, dh, ds, dl)
+
+
+class RandomRotateAug(Augmenter):
+    """Rotate by a random integer degree in [-max_rotate_angle,
+    max_rotate_angle], or by the fixed ``rotate`` angle when set
+    (reference image_aug_default.cc: ``rotate`` overrides
+    ``max_rotate_angle``; constant ``fill_value`` border)."""
+
+    def __init__(self, max_rotate_angle=0, rotate=-1, fill_value=255,
+                 interp=1):
+        self.max_rotate_angle = int(max_rotate_angle)
+        self.rotate = rotate
+        self.fill_value = fill_value
+        self.interp = interp
+
+    def __call__(self, src):
+        if self.rotate > 0:
+            angle = self.rotate
+        else:
+            angle = pyrandom.randint(-self.max_rotate_angle,
+                                     self.max_rotate_angle)
+        if angle == 0:
+            return src
+        return rotate_image(src, angle, self.fill_value, self.interp)
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, min_area, ratio, interp=2):
+        self.size, self.min_area, self.ratio, self.interp = \
+            size, min_area, ratio, interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.min_area, self.ratio,
+                                self.interp)[0]
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
-                    contrast=0, saturation=0, inter_method=2):
-    """Build the standard augmenter list (reference image.py
-    CreateAugmenter)."""
+                    contrast=0, saturation=0, pca_noise=0,
+                    max_rotate_angle=0, rotate=-1, fill_value=255,
+                    random_h=0, random_s=0, random_l=0, inter_method=2):
+    """Build the standard augmenter list (reference image.py:397
+    CreateAugmenter, plus the native augmenter's geometric/color params
+    from image_aug_default.cc: max_rotate_angle/rotate/fill_value and
+    random_h/s/l so the Python path can mirror the C++ pipeline). Every
+    accepted argument is honored — unknown needs should raise upstream,
+    never be silently dropped."""
     auglist: List[Augmenter] = []
     if resize > 0:
         auglist.append(ResizeAug(resize, inter_method))
+    if max_rotate_angle > 0 or rotate > 0:
+        # native order: affine rotation after resize, before crop
+        auglist.append(RandomRotateAug(max_rotate_angle, rotate, fill_value))
     crop_size = (data_shape[2], data_shape[1])
-    if rand_crop:
+    if rand_resize:
+        assert rand_crop, "rand_resize requires rand_crop"
+        auglist.append(RandomSizedCropAug(crop_size, 0.3,
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
         auglist.append(RandomCropAug(crop_size, inter_method))
     else:
         auglist.append(CenterCropAug(crop_size, inter_method))
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
+    if random_h or random_s or random_l:
+        # uint8 HLS-space jitter must precede the float cast (native order:
+        # color-space aug after crop)
+        auglist.append(HSLJitterAug(random_h, random_s, random_l))
     auglist.append(CastAug())
-    if brightness:
-        auglist.append(BrightnessJitterAug(brightness))
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        auglist.append(LightingAug(pca_noise, PCA_EIGVAL, PCA_EIGVEC))
     if mean is True:
         mean = np.array([123.68, 116.28, 103.53])
     if std is True:

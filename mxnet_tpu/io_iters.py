@@ -32,7 +32,9 @@ class ImageRecordIter(DataIter):
                  rand_mirror=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0, resize=0,
                  preprocess_threads=4, num_parts=1, part_index=0,
-                 seed=0, prefetch_buffer=2, round_batch=True, **kwargs):
+                 seed=0, prefetch_buffer=2, round_batch=True,
+                 max_rotate_angle=0, rotate=-1, fill_value=255,
+                 random_h=0, random_s=0, random_l=0, **kwargs):
         super().__init__(batch_size)
         self.data_shape = tuple(int(x) for x in data_shape)
         self._path = path_imgrec
@@ -41,6 +43,9 @@ class ImageRecordIter(DataIter):
         self._provide_label = [DataDesc("softmax_label", (batch_size,))]
         self._native = None
         self._py_fallback = None
+        aug_kwargs = dict(max_rotate_angle=max_rotate_angle, rotate=rotate,
+                          fill_value=fill_value, random_h=random_h,
+                          random_s=random_s, random_l=random_l)
         try:
             from .native import NativeImageLoader
 
@@ -52,13 +57,15 @@ class ImageRecordIter(DataIter):
                 std_rgb=(std_r, std_g, std_b),
                 part_index=part_index, num_parts=num_parts, seed=seed,
                 resize_shorter=resize, queue_depth=prefetch_buffer,
-                shuffle_buffer=(max(4 * batch_size, 2048) if shuffle else 0))
+                shuffle_buffer=(max(4 * batch_size, 2048) if shuffle else 0),
+                **aug_kwargs)
         except Exception:
             self._py_fallback = _PyImageRecordReader(
                 path_imgrec, self.data_shape, rand_crop, rand_mirror,
                 (mean_r, mean_g, mean_b), (std_r, std_g, std_b), resize,
                 part_index, num_parts, seed,
-                shuffle_buffer=(max(4 * batch_size, 2048) if shuffle else 0))
+                shuffle_buffer=(max(4 * batch_size, 2048) if shuffle else 0),
+                **aug_kwargs)
 
     @property
     def provide_data(self):
@@ -101,7 +108,9 @@ class _PyImageRecordReader:
     sharding + streaming shuffle delegate to _ShardedRecordStream."""
 
     def __init__(self, path, data_shape, rand_crop, rand_mirror, mean, std,
-                 resize, part_index, num_parts, seed, shuffle_buffer=0):
+                 resize, part_index, num_parts, seed, shuffle_buffer=0,
+                 max_rotate_angle=0, rotate=-1, fill_value=255,
+                 random_h=0, random_s=0, random_l=0):
         self._stream = _ShardedRecordStream(path, part_index, num_parts,
                                             seed, shuffle_buffer)
         self.data_shape = data_shape
@@ -110,6 +119,11 @@ class _PyImageRecordReader:
         self.mean = np.asarray(mean, np.float32).reshape(3, 1, 1)
         self.std = np.asarray(std, np.float32).reshape(3, 1, 1)
         self.resize = resize
+        self.max_rotate_angle = int(max_rotate_angle)
+        self.rotate = rotate
+        self.fill_value = fill_value
+        self.random_h, self.random_s, self.random_l = \
+            int(random_h), int(random_s), int(random_l)
         self._rng = np.random.RandomState(seed)
 
     def reset(self):
@@ -143,6 +157,24 @@ class _PyImageRecordReader:
                                        int(img.shape[0] * scale + 0.5)))
             elif img.shape[0] != h or img.shape[1] != w:
                 img = cv2.resize(img, (w, h))
+            if self.rotate > 0 or self.max_rotate_angle > 0:
+                from .image import _rotate_arr
+
+                angle = (self.rotate if self.rotate > 0 else
+                         int(self._rng.randint(-self.max_rotate_angle,
+                                               self.max_rotate_angle + 1)))
+                if angle:
+                    img = _rotate_arr(img, angle, self.fill_value)
+            if self.random_h or self.random_s or self.random_l:
+                from .image import _hsl_arr
+
+                def draw(v):
+                    return int(self._rng.randint(-v, v + 1)) if v else 0
+
+                dh, ds, dl = (draw(self.random_h), draw(self.random_s),
+                              draw(self.random_l))
+                if dh or ds or dl:
+                    img = _hsl_arr(img, dh, ds, dl)
             # edge-pad if the (resized) image is smaller than the crop —
             # matches the native loader's edge-clamped reads
             if img.shape[0] < h or img.shape[1] < w:

@@ -27,16 +27,30 @@ def load() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_SO):
+    src = os.path.join(os.path.dirname(_SO), "recordio.cc")
+    stale = (os.path.exists(_SO) and os.path.exists(src)
+             and os.path.getmtime(src) > os.path.getmtime(_SO))
+    if not os.path.exists(_SO) or stale:
         try:
             subprocess.run(["make", "-C", os.path.dirname(_SO)], check=True,
                            capture_output=True, timeout=120)
         except Exception:
-            return None
+            if stale:  # keep using the older (but loadable) build
+                pass
+            else:
+                return None
     try:
         lib = ctypes.CDLL(_SO)
-    except OSError:
+        _bind(lib)
+    except (OSError, AttributeError):
+        # missing file OR a stale prebuilt .so without the newer symbols:
+        # degrade to the pure-Python path rather than crash
         return None
+    _lib = lib
+    return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
     lib.mxio_reader_open.restype = ctypes.c_void_p
     lib.mxio_reader_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
     lib.mxio_reader_next.restype = ctypes.c_int
@@ -57,19 +71,52 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
         ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
-        ctypes.c_int, ctypes.c_int]
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+    lib.mxio_aug_rotate.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+        ctypes.c_float, ctypes.c_int, ctypes.POINTER(ctypes.c_uint8)]
+    lib.mxio_aug_hsl.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int]
     lib.mxio_imgloader_next.restype = ctypes.c_int
     lib.mxio_imgloader_next.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
         ctypes.POINTER(ctypes.c_float)]
     lib.mxio_imgloader_reset.argtypes = [ctypes.c_void_p]
     lib.mxio_imgloader_destroy.argtypes = [ctypes.c_void_p]
-    _lib = lib
-    return _lib
 
 
 def available() -> bool:
     return load() is not None
+
+
+def aug_rotate(img: np.ndarray, angle: float, fill: int = 255) -> np.ndarray:
+    """Native rotation transform on an (H, W, 3) uint8 RGB array (exported
+    for golden tests vs image.rotate_image)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native io library unavailable")
+    img = np.ascontiguousarray(img, np.uint8)
+    h, w = img.shape[:2]
+    out = np.empty_like(img)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.mxio_aug_rotate(img.ctypes.data_as(u8p), w, h,
+                        ctypes.c_float(angle), fill,
+                        out.ctypes.data_as(u8p))
+    return out
+
+
+def aug_hsl(img: np.ndarray, dh: int, ds: int, dl: int) -> np.ndarray:
+    """Native HLS-space jitter on an (H, W, 3) uint8 RGB array (exported
+    for golden tests vs image.hsl_shift)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native io library unavailable")
+    out = np.ascontiguousarray(img, np.uint8).copy()
+    h, w = out.shape[:2]
+    lib.mxio_aug_hsl(out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                     w, h, dh, ds, dl)
+    return out
 
 
 class NativeRecordReader:
@@ -114,7 +161,9 @@ class NativeImageLoader:
     def __init__(self, path, batch_size, data_shape, nthreads=4,
                  rand_crop=False, rand_mirror=False, mean_rgb=None,
                  std_rgb=None, part_index=0, num_parts=1, seed=0,
-                 resize_shorter=0, queue_depth=2, shuffle_buffer=0):
+                 resize_shorter=0, queue_depth=2, shuffle_buffer=0,
+                 max_rotate_angle=0, rotate=-1, fill_value=255,
+                 random_h=0, random_s=0, random_l=0):
         lib = load()
         if lib is None:
             raise RuntimeError("native io library unavailable")
@@ -122,6 +171,9 @@ class NativeImageLoader:
         c, h, w = data_shape
         mean = (ctypes.c_float * 3)(*(mean_rgb or (0.0, 0.0, 0.0)))
         std = (ctypes.c_float * 3)(*(std_rgb or (1.0, 1.0, 1.0)))
+        aug = (ctypes.c_int * 6)(int(max_rotate_angle), int(rotate),
+                                 int(fill_value), int(random_h),
+                                 int(random_s), int(random_l))
         self.batch_size = batch_size
         self.data_shape = data_shape
         self._data = np.empty((batch_size, c, h, w), np.float32)
@@ -130,7 +182,7 @@ class NativeImageLoader:
             path.encode(), batch_size, h, w, c, nthreads,
             int(rand_crop), int(rand_mirror), mean, std,
             part_index, num_parts, seed, resize_shorter, queue_depth,
-            shuffle_buffer)
+            shuffle_buffer, aug)
         if not self._h:
             raise IOError("cannot open %s" % path)
 

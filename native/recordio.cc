@@ -151,12 +151,108 @@ void Resize(const std::vector<uint8_t>& src, int sw, int sh,
   }
 }
 
+// ----------------------------------------------------- augment transforms --
+// Rotate an RGB u8 image about its center by `angle` degrees, same output
+// size, constant `fill` border (the reference affine at scale=1/shear=0:
+// src/io/image_aug_default.cc:215-246). Inverse-mapped bilinear sampling,
+// matching cv::warpAffine(INTER_LINEAR, BORDER_CONSTANT).
+void RotateU8(const uint8_t* src, int w, int h, float angle, int fill,
+              uint8_t* dst) {
+  float a = std::cos(angle / 180.0f * (float)M_PI);
+  float b = std::sin(angle / 180.0f * (float)M_PI);
+  // forward M = [[a, b, tx], [-b, a, ty]] with the centering translation
+  float tx = (w - (a * w + b * h)) / 2.0f;
+  float ty = (h - (-b * w + a * h)) / 2.0f;
+  // inverse of a pure rotation+translation: R^T, -R^T t
+  for (int y = 0; y < h; ++y) {
+    for (int x = 0; x < w; ++x) {
+      float sx = a * (x - tx) + (-b) * (y - ty);
+      float sy = b * (x - tx) + a * (y - ty);
+      uint8_t* out = dst + ((size_t)y * w + x) * 3;
+      if (sx < -1 || sy < -1 || sx >= w || sy >= h) {
+        out[0] = out[1] = out[2] = (uint8_t)fill;
+        continue;
+      }
+      int x0 = (int)std::floor(sx), y0 = (int)std::floor(sy);
+      float wx = sx - x0, wy = sy - y0;
+      for (int c = 0; c < 3; ++c) {
+        // sample with constant fill outside the source
+        auto at = [&](int yy, int xx) -> float {
+          if (xx < 0 || yy < 0 || xx >= w || yy >= h) return (float)fill;
+          return src[((size_t)yy * w + xx) * 3 + c];
+        };
+        float v = at(y0, x0) * (1 - wy) * (1 - wx) +
+                  at(y0, x0 + 1) * (1 - wy) * wx +
+                  at(y0 + 1, x0) * wy * (1 - wx) +
+                  at(y0 + 1, x0 + 1) * wy * wx;
+        out[c] = (uint8_t)(v + 0.5f);
+      }
+    }
+  }
+}
+
+// Additive jitter in 8-bit HLS space with clipping — the reference
+// color-space augmentation (image_aug_default.cc:297-316: per-pixel add of
+// (h, l, s) clipped to (180, 255, 255)). In-place on RGB u8. The RGB<->HLS
+// math follows OpenCV's 8-bit convention (H in [0,180]).
+void HslShiftU8(uint8_t* img, int w, int h, int dh, int ds, int dl) {
+  for (size_t i = 0, n = (size_t)w * h; i < n; ++i) {
+    uint8_t* p = img + i * 3;
+    float r = p[0] / 255.0f, g = p[1] / 255.0f, bl = p[2] / 255.0f;
+    float vmax = std::max(r, std::max(g, bl));
+    float vmin = std::min(r, std::min(g, bl));
+    float L = (vmax + vmin) / 2.0f;
+    float H = 0, S = 0;
+    float d = vmax - vmin;
+    if (d > 0) {
+      S = (L < 0.5f) ? d / (vmax + vmin) : d / (2.0f - vmax - vmin);
+      if (vmax == r)
+        H = 60.0f * (g - bl) / d;
+      else if (vmax == g)
+        H = 120.0f + 60.0f * (bl - r) / d;
+      else
+        H = 240.0f + 60.0f * (r - g) / d;
+      if (H < 0) H += 360.0f;
+    }
+    // 8-bit HLS: H/2 in [0,180], L,S scaled to [0,255]; add + clip
+    int Hi = (int)(H / 2.0f + 0.5f) + dh;
+    int Li = (int)(L * 255.0f + 0.5f) + dl;
+    int Si = (int)(S * 255.0f + 0.5f) + ds;
+    Hi = std::max(0, std::min(180, Hi));
+    Li = std::max(0, std::min(255, Li));
+    Si = std::max(0, std::min(255, Si));
+    // back to RGB (standard HLS->RGB, OpenCV convention)
+    H = Hi * 2.0f;
+    L = Li / 255.0f;
+    S = Si / 255.0f;
+    float c = (1.0f - std::fabs(2.0f * L - 1.0f)) * S;
+    float Hp = H / 60.0f;
+    float xc = c * (1.0f - std::fabs(std::fmod(Hp, 2.0f) - 1.0f));
+    float r1 = 0, g1 = 0, b1 = 0;
+    if (Hp < 1) { r1 = c; g1 = xc; }
+    else if (Hp < 2) { r1 = xc; g1 = c; }
+    else if (Hp < 3) { g1 = c; b1 = xc; }
+    else if (Hp < 4) { g1 = xc; b1 = c; }
+    else if (Hp < 5) { r1 = xc; b1 = c; }
+    else { r1 = c; b1 = xc; }
+    float m = L - c / 2.0f;
+    p[0] = (uint8_t)std::max(0.0f, std::min(255.0f, (r1 + m) * 255.0f + 0.5f));
+    p[1] = (uint8_t)std::max(0.0f, std::min(255.0f, (g1 + m) * 255.0f + 0.5f));
+    p[2] = (uint8_t)std::max(0.0f, std::min(255.0f, (b1 + m) * 255.0f + 0.5f));
+  }
+}
+
 // ------------------------------------------------------------ img loader --
 struct LoaderCfg {
   int batch, H, W, C;
   int rand_crop, rand_mirror;
   float mean[3], std[3];
   int resize_shorter;  // 0 = resize directly to HxW
+  // geometric/color augmentation (reference DefaultImageAugmentParam)
+  int max_rotate_angle = 0;  // random angle in [-v, v]
+  int rotate = -1;           // fixed angle; overrides max_rotate_angle
+  int fill_value = 255;      // border fill for rotation
+  int random_h = 0, random_s = 0, random_l = 0;  // HLS jitter extents
 };
 
 struct Batch {
@@ -224,6 +320,20 @@ struct ImgLoader {
       sw = cw;
       sh = ch;
     }
+    // rotation (reference order: affine after resize, before crop)
+    std::vector<uint8_t> rotated;
+    if (c.rotate > 0 || c.max_rotate_angle > 0) {
+      int angle = c.rotate > 0
+          ? c.rotate
+          : (int)((*rng)() % (uint32_t)(2 * c.max_rotate_angle + 1)) -
+                c.max_rotate_angle;
+      if (angle != 0) {
+        rotated.resize((size_t)sw * sh * 3);
+        RotateU8(src->data(), sw, sh, (float)angle, c.fill_value,
+                 rotated.data());
+        src = &rotated;
+      }
+    }
     // crop
     int x0 = (sw - cw) / 2, y0 = (sh - ch) / 2;
     if (c.rand_crop && sw > cw) x0 = (int)((*rng)() % (uint32_t)(sw - cw + 1));
@@ -231,6 +341,31 @@ struct ImgLoader {
     x0 = std::max(0, x0);
     y0 = std::max(0, y0);
     bool mirror = c.rand_mirror && ((*rng)() & 1);
+    // HLS color jitter (reference order: color-space aug after crop).
+    // Materialize just the crop window so the float HLS round-trip runs on
+    // cw*ch pixels, not the whole resized image.
+    std::vector<uint8_t> jittered;
+    if (c.random_h || c.random_s || c.random_l) {
+      auto draw = [&](int v) {
+        return v ? (int)((*rng)() % (uint32_t)(2 * v + 1)) - v : 0;
+      };
+      int dh = draw(c.random_h), ds = draw(c.random_s), dl = draw(c.random_l);
+      if (dh || ds || dl) {
+        jittered.resize((size_t)cw * ch * 3);
+        for (int y = 0; y < ch; ++y) {
+          for (int x = 0; x < cw; ++x) {
+            int yy = std::min(sh - 1, y0 + y), xx = std::min(sw - 1, x0 + x);
+            memcpy(&jittered[((size_t)y * cw + x) * 3],
+                   &(*src)[((size_t)yy * sw + xx) * 3], 3);
+          }
+        }
+        HslShiftU8(jittered.data(), cw, ch, dh, ds, dl);
+        src = &jittered;
+        sw = cw;
+        sh = ch;
+        x0 = y0 = 0;
+      }
+    }
 
     float* dst = b->data.data() + (size_t)w.slot * c.C * ch * cw;
     for (int cc = 0; cc < c.C; ++cc) {
@@ -402,12 +537,15 @@ void mxio_writer_close(void* h) {
 }
 
 // ---- threaded image loader ----
+// aug_params: optional int[6] {max_rotate_angle, rotate, fill_value,
+// random_h, random_s, random_l} (reference DefaultImageAugmentParam);
+// nullptr keeps the defaults (no rotation, no color jitter).
 void* mxio_imgloader_create(const char* path, int batch, int H, int W, int C,
                             int nthreads, int rand_crop, int rand_mirror,
                             const float* mean_rgb, const float* std_rgb,
                             int part, int nparts, uint64_t seed,
                             int resize_shorter, int queue_depth,
-                            int shuffle_buffer) {
+                            int shuffle_buffer, const int* aug_params) {
   FILE* fp = fopen(path, "rb");
   if (!fp) return nullptr;
   ImgLoader* L = new ImgLoader();
@@ -419,6 +557,14 @@ void* mxio_imgloader_create(const char* path, int batch, int H, int W, int C,
   for (int i = 0; i < 3; ++i) {
     if (mean_rgb) L->cfg.mean[i] = mean_rgb[i];
     if (std_rgb) L->cfg.std[i] = std_rgb[i];
+  }
+  if (aug_params) {
+    L->cfg.max_rotate_angle = aug_params[0];
+    L->cfg.rotate = aug_params[1];
+    L->cfg.fill_value = aug_params[2];
+    L->cfg.random_h = aug_params[3];
+    L->cfg.random_s = aug_params[4];
+    L->cfg.random_l = aug_params[5];
   }
   L->nthreads = nthreads;
   L->seed = seed;
@@ -484,6 +630,17 @@ void mxio_imgloader_destroy(void* h) {
   L->Stop();
   fclose(L->reader.fp);
   delete L;
+}
+
+// ---- augment transforms (exported for golden tests against the Python/
+// cv2 implementations of the same reference formulas) ----
+void mxio_aug_rotate(const uint8_t* src, int w, int h, float angle, int fill,
+                     uint8_t* dst) {
+  RotateU8(src, w, h, angle, fill, dst);
+}
+
+void mxio_aug_hsl(uint8_t* img, int w, int h, int dh, int ds, int dl) {
+  HslShiftU8(img, w, h, dh, ds, dl);
 }
 
 }  // extern "C"
