@@ -275,3 +275,67 @@ def wait_for_var(var):
 
 def wait_for_all():
     get().wait_for_all()
+
+
+# --- file-write routing ------------------------------------------------------
+# Checkpoint/state blob writes ride the engine with one write-var per file
+# path (the reference's NDArray save-through-engine: every host mutation of
+# a named resource is an engine op, kvstore_dist.h:233-241 being the PS
+# analogue). Writers push with the path's var mutable; readers wait on the
+# var, so an in-flight async checkpoint is never half-read.
+_file_vars: Dict[str, int] = {}
+_file_errs: Dict[str, BaseException] = {}
+_file_lock = threading.Lock()
+
+
+def file_var(path: str) -> int:
+    """The engine write-var owning ``path`` (created on first use)."""
+    path = os.path.abspath(path)
+    with _file_lock:
+        v = _file_vars.get(path)
+        if v is None:
+            v = get().new_variable()
+            _file_vars[path] = v
+        return v
+
+
+def push_file_write(path: str, fn: Callable[[], None], wait: bool = True,
+                    name: Optional[str] = None):
+    """Run ``fn`` (which writes ``path``) as an engine op holding the
+    path's write-var. ``wait=False`` returns immediately — the write
+    overlaps whatever the caller does next; any exception surfaces at the
+    next ``wait_for_file``/``push_file_write`` on the same path."""
+    apath = os.path.abspath(path)
+    # surface a previously-recorded failure for this path NOW, so a loop of
+    # async saves can't silently lose every checkpoint after the disk fills
+    with _file_lock:
+        prev = _file_errs.pop(apath, None)
+    if prev is not None:
+        raise prev
+    var = file_var(apath)
+
+    def run():
+        try:
+            fn()
+        except BaseException as e:  # surface at the next sync point
+            with _file_lock:
+                _file_errs[apath] = e
+
+    get().push(run, mutable_vars=[var],
+               name=name or ("file_write:%s" % os.path.basename(apath)))
+    if wait:
+        wait_for_file(apath)
+
+
+def wait_for_file(path: str):
+    """Block until every pending engine op on ``path`` finished; re-raise
+    the first failure recorded for it."""
+    apath = os.path.abspath(path)
+    with _file_lock:
+        var = _file_vars.get(apath)
+    if var is not None:
+        get().wait_for_var(var)
+    with _file_lock:
+        err = _file_errs.pop(apath, None)
+    if err is not None:
+        raise err

@@ -375,13 +375,14 @@ class RandomSizedCropAug(Augmenter):
 
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
-                    contrast=0, saturation=0, pca_noise=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2, *,
                     max_rotate_angle=0, rotate=-1, fill_value=255,
-                    random_h=0, random_s=0, random_l=0, inter_method=2):
-    """Build the standard augmenter list (reference image.py:397
-    CreateAugmenter, plus the native augmenter's geometric/color params
-    from image_aug_default.cc: max_rotate_angle/rotate/fill_value and
-    random_h/s/l so the Python path can mirror the C++ pipeline). Every
+                    random_h=0, random_s=0, random_l=0):
+    """Build the standard augmenter list. Positional signature matches the
+    reference (image.py:397 CreateAugmenter, through ``inter_method``); the
+    native augmenter's geometric/color params from image_aug_default.cc
+    (max_rotate_angle/rotate/fill_value, random_h/s/l) are keyword-only
+    extensions so the Python path can mirror the C++ pipeline. Every
     accepted argument is honored — unknown needs should raise upstream,
     never be silently dropped."""
     auglist: List[Augmenter] = []

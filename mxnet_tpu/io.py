@@ -367,12 +367,18 @@ class PrefetchingIter(DataIter):
 
 
 class DevicePrefetchIter(DataIter):
-    """Host→device prefetch: a background thread pulls batches from the
-    wrapped iterator and *places them on device* ahead of consumption, so
-    host decode AND the H2D transfer overlap the device step — the
-    TPU-native recreation of the reference's pinned-buffer + copy-stream
-    pipelining (PrefetcherIter feeding kCopyToGPU engine ops, SURVEY §3.1,
-    and the infeed double-buffering called out in §7's risk register).
+    """Host→device prefetch: engine ops pull batches from the wrapped
+    iterator and *place them on device* ahead of consumption, so host
+    decode AND the H2D transfer overlap the device step — the TPU-native
+    recreation of the reference's pinned-buffer + copy-stream pipelining
+    (PrefetcherIter feeding kCopyToGPU engine ops, SURVEY §3.1, and the
+    infeed double-buffering called out in §7's risk register).
+
+    Each prefetch stage is an engine op holding the iterator's write-var
+    (exactly the reference: iter_prefetcher.h:28 pushes the copy as an
+    engine op on the output's var), so base-iterator access serializes in
+    push order while independent host work (checkpoint writes, PS RPCs)
+    runs concurrently on the same worker pool.
 
     depth = number of device-resident batches kept in flight (2 =
     classic double buffering)."""
@@ -380,18 +386,20 @@ class DevicePrefetchIter(DataIter):
     def __init__(self, base, ctx=None, depth=2, cast_dtype=None):
         import queue as _queue
 
+        from . import engine
+
         super().__init__(getattr(base, "batch_size", 0))
         self._base = base
         self._ctx = ctx
         self._cast = cast_dtype  # cast data ON DEVICE after the transfer
         #   (uint8 wire format + device-side cast: 4x less H2D traffic)
         self._depth = max(1, int(depth))
-        self._q = _queue.Queue(maxsize=self._depth)
+        self._q = _queue.Queue()
         self._gen = 0
         self._lock = threading.Lock()
-        self._thread = None
+        self._engine = engine
+        self._iter_var = engine.get().new_variable()
         self._done = False
-        self._wedged = False  # worker failed to join: refuse base reuse
         self._start()
 
     def _device(self):
@@ -425,32 +433,37 @@ class DevicePrefetchIter(DataIter):
     def _start(self):
         with self._lock:
             self._gen += 1
-            gen = self._gen
-        self._q = type(self._q)(maxsize=self._depth)
+        self._q = type(self._q)()
         self._done = False
+        # prime the pipeline: `depth` prefetch ops in flight; next() pushes
+        # one replacement op per consumed batch
+        for _ in range(self._depth):
+            self._push_fetch()
+
+    def _push_fetch(self):
+        with self._lock:
+            gen = self._gen
         q = self._q
 
-        def worker():
-            while True:
-                with self._lock:
-                    if gen != self._gen:
-                        return
-                try:
-                    batch = self._base.next()
-                except StopIteration:
-                    q.put(None)
+        def fetch(gen=gen, q=q):
+            with self._lock:
+                if gen != self._gen:  # retired generation: no-op
                     return
-                except BaseException as e:  # surface in the consumer —
-                    q.put(e)                # a silent death would hang next()
-                    return
-                try:
-                    q.put(self._place(batch))
-                except BaseException as e:
-                    q.put(e)
-                    return
+            try:
+                batch = self._base.next()
+            except StopIteration:
+                q.put(None)
+                return
+            except BaseException as e:  # surface in the consumer —
+                q.put(e)                # a silent death would hang next()
+                return
+            try:
+                q.put(self._place(batch))
+            except BaseException as e:
+                q.put(e)
 
-        self._thread = threading.Thread(target=worker, daemon=True)
-        self._thread.start()
+        self._engine.get().push(fetch, mutable_vars=[self._iter_var],
+                                name="prefetch_batch")
 
     @property
     def provide_data(self):
@@ -461,33 +474,28 @@ class DevicePrefetchIter(DataIter):
         return self._base.provide_label
 
     def _retire_worker(self):
-        """Stop and JOIN the current worker before anyone else touches the
-        (non-thread-safe) base iterator."""
+        """Invalidate queued prefetch ops and WAIT on the iterator var so
+        nothing touches the (non-thread-safe) base iterator afterwards."""
         with self._lock:
-            self._gen += 1
-        # drain so a producer blocked in q.put can finish and exit
+            self._gen += 1  # in-queue ops become no-ops
+        # bounded wait: a fetch wedged in a device transfer must not hang
+        # reset()/close() (and interpreter shutdown) forever
+        waiter = threading.Thread(
+            target=self._engine.get().wait_for_var, args=(self._iter_var,),
+            daemon=True)
+        waiter.start()
+        waiter.join(timeout=60)
+        if waiter.is_alive():
+            raise RuntimeError(
+                "DevicePrefetchIter: in-flight prefetch op did not finish "
+                "within 60s; refusing to reuse the base iterator while it "
+                "may still be reading it")
+        # drop already-produced batches of the retired generation
         try:
             while True:
                 self._q.get_nowait()
         except Exception:
             pass
-        t = self._thread
-        if t is not None and t.is_alive():
-            # once wedged, re-join briefly instead of another full 60s wait
-            t.join(timeout=5 if self._wedged else 60)
-            if t.is_alive():
-                # Worker stuck past the timeout (e.g. wedged device
-                # transfer): touching the non-thread-safe base iterator now
-                # would race with it. Keep the reference but mark the
-                # iterator wedged so repeated reset()/close() keep refusing
-                # (with a short re-join, not another full 60s).
-                self._wedged = True
-                raise RuntimeError(
-                    "DevicePrefetchIter: worker thread did not exit within "
-                    "60s; refusing to reuse the base iterator while it may "
-                    "still be reading it")
-        self._thread = None
-        self._wedged = False
 
     def reset(self):
         self._retire_worker()
@@ -504,11 +512,13 @@ class DevicePrefetchIter(DataIter):
         if isinstance(batch, BaseException):
             self._done = True
             raise batch
+        # keep `depth` fetches in flight
+        self._push_fetch()
         return batch
 
     def close(self):
-        """Stop the prefetch thread (join it) — call before interpreter
-        shutdown: a daemon thread killed mid-device-transfer aborts the
+        """Retire in-flight prefetch ops — call before interpreter
+        shutdown: an engine op killed mid-device-transfer aborts the
         process on some PJRT plugins."""
         self._retire_worker()
 

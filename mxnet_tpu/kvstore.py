@@ -200,6 +200,7 @@ class PSKVStore(KVStore):
 
     def __init__(self, kv_type):
         super().__init__(kv_type)
+        from . import engine
         from .kvstore_server import PSClient, num_workers
 
         self._client = PSClient()
@@ -207,10 +208,36 @@ class PSKVStore(KVStore):
         self._rank = int(__import__("os").environ.get(
             "MXNET_TPU_WORKER_RANK",
             __import__("os").environ.get("DMLC_WORKER_ID", "0")))
+        # PS RPCs are engine ops with one var per key (the reference's
+        # KVStoreDist: ZPush/ZPull run on the engine holding the buffer
+        # vars, kvstore_dist.h:233-241) — pushes return immediately and
+        # overlap the training step; a pull of the same key orders after
+        # every outstanding push of that key.
+        self._engine = engine
+        self._key_vars = {}
+        self._rpc_errs = []
+        self._errs_lock = __import__("threading").Lock()
         if self._rank == 0:
             # rank-0 worker announces the consistency mode, as in
             # kvstore.cc:31-38 (kSyncMode command to servers)
             self._client.set_sync("async" not in kv_type)
+
+    def _key_var(self, key):
+        v = self._key_vars.get(key)
+        if v is None:
+            v = self._engine.get().new_variable()
+            self._key_vars[key] = v
+        return v
+
+    def _record_err(self, e):
+        with self._errs_lock:
+            self._rpc_errs.append(e)
+
+    def _raise_pending(self):
+        with self._errs_lock:
+            errs, self._rpc_errs = self._rpc_errs, []
+        if errs:
+            raise errs[0]
 
     @property
     def rank(self):
@@ -223,28 +250,62 @@ class PSKVStore(KVStore):
     def init(self, key, value):
         keys, values = _key_value(key, value)
         for k, v in zip(keys, values):
-            self._client.init(k, v.asnumpy())
+            arr = v.asnumpy()
+            self._engine.get().push(
+                lambda k=k, arr=arr: self._safe_rpc(
+                    lambda: self._client.init(k, arr)),
+                mutable_vars=[self._key_var(k)], name="ps_init")
         self.barrier()
 
+    def _safe_rpc(self, fn):
+        try:
+            fn()
+        except BaseException as e:  # surface at the next sync point
+            self._record_err(e)
+
     def push(self, key, value, priority=0):
+        """Async: the RPC (device readback + wire) runs as an engine op
+        holding the key's var — the training thread keeps going, exactly
+        the reference's engine-threaded ZPush (kvstore_dist.h:233-241)."""
+        import jax.numpy as jnp
+
         keys, grouped = _group_kv(key, value)
         for k, vals in zip(keys, grouped):
             merged = _reduce(vals)  # local device reduce before the wire
-            self._client.push(k, merged.asnumpy())
+            # device-side copy: the caller's buffer may be DONATED by the
+            # next fused step before the engine op reads it back; the copy
+            # is a fresh buffer, and the (slow, tunneled) D2H readback
+            # still overlaps training inside the engine op
+            m = NDArray(jnp.copy(merged._data))
+            self._engine.get().push(
+                lambda k=k, m=m: self._safe_rpc(
+                    lambda: self._client.push(k, m.asnumpy())),
+                mutable_vars=[self._key_var(k)], priority=priority,
+                name="ps_push")
 
     def pull(self, key, out=None, priority=0):
         keys, grouped = _group_kv(key, out)
         for k, outs in zip(keys, grouped):
             ref_shape = tuple(outs[0].shape)
-            # element count selects the same shard plan as the push side
-            # (kvstore_dist.h EncodeKey); sharded pulls return flat
-            val = self._client.pull(k, size=int(np.prod(ref_shape)))
-            val = np.asarray(val).reshape(ref_shape)
-            for o in outs:
-                # preserve the target's mesh sharding (Comm::Broadcast
-                # semantics), as base KVStore.pull does
-                o._data = jax.device_put(val.astype(o.dtype),
-                                         o._data.sharding)
+
+            def do_pull(k=k, outs=outs, ref_shape=ref_shape):
+                # element count selects the same shard plan as the push
+                # side (kvstore_dist.h EncodeKey); sharded pulls are flat
+                val = self._client.pull(k, size=int(np.prod(ref_shape)))
+                val = np.asarray(val).reshape(ref_shape)
+                for o in outs:
+                    # preserve the target's mesh sharding (Comm::Broadcast
+                    # semantics), as base KVStore.pull does
+                    o._data = jax.device_put(val.astype(o.dtype),
+                                             o._data.sharding)
+
+            # engine-ordered after every outstanding push of this key
+            self._engine.get().push(lambda f=do_pull: self._safe_rpc(f),
+                                    mutable_vars=[self._key_var(k)],
+                                    priority=priority, name="ps_pull")
+        for k in keys:
+            self._engine.get().wait_for_var(self._key_var(k))
+        self._raise_pending()
 
     def set_optimizer(self, optimizer):
         self._optimizer = optimizer
@@ -253,9 +314,17 @@ class PSKVStore(KVStore):
         self.barrier()
 
     def barrier(self):
+        # flush every queued push/pull first: a barrier with RPCs still in
+        # the engine queue would not be a barrier
+        for v in self._key_vars.values():
+            self._engine.get().wait_for_var(v)
+        self._raise_pending()
         self._client.barrier()
 
     def stop_server(self):
+        for v in self._key_vars.values():
+            self._engine.get().wait_for_var(v)
+        self._raise_pending()
         if self._rank == 0:
             self._client.stop()
 

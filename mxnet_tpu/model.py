@@ -85,20 +85,42 @@ def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None)
         updater.update_all(pairs)
 
 
-def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
-    """Save symbol JSON + params blob (reference model.py:319-347)."""
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    async_write=False):
+    """Save symbol JSON + params blob (reference model.py:319-347).
+
+    The blob write is an engine op holding the file's write-var (the
+    reference routes every checkpoint store through the engine). With
+    ``async_write=True`` the call returns once the in-memory snapshot is
+    taken — serialization and disk IO overlap continued training; readers
+    (``load_checkpoint``) wait on the same var, and
+    ``engine.wait_for_file(path)`` syncs explicitly."""
+    from . import engine
+
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
-    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    # snapshot NOW: rewrap the current (immutable) device buffers so later
+    # training steps can't bleed into an in-flight async write
+    save_dict = {("arg:%s" % k): nd.NDArray(v._data)
+                 for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): nd.NDArray(v._data)
+                      for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
-    logging.info("Saved checkpoint to \"%s\"", param_name)
+    engine.push_file_write(param_name,
+                           lambda: nd.save(param_name, save_dict),
+                           wait=not async_write, name="checkpoint_write")
+    logging.info("Saved checkpoint to \"%s\"%s", param_name,
+                 " (async)" if async_write else "")
 
 
 def load_checkpoint(prefix, epoch):
-    """(reference model.py:349-384)."""
+    """(reference model.py:349-384). Waits on the params file's engine
+    write-var first, so a checkpoint still being written asynchronously is
+    read only after it is complete."""
+    from . import engine
+
     symbol = sym_mod.load("%s-symbol.json" % prefix)
+    engine.wait_for_file("%s-%04d.params" % (prefix, epoch))
     save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
     arg_params = {}
     aux_params = {}

@@ -253,13 +253,24 @@ class BaseModule:
                          aux_params=aux_params, allow_missing=allow_missing,
                          force_init=force_init)
 
-    def save_params(self, fname):
+    def save_params(self, fname, async_write=False):
+        """Engine-routed blob write (one write-var per path); with
+        ``async_write=True`` serialization/IO overlap continued training —
+        the snapshot is taken at call time (immutable device buffers)."""
+        from .. import engine
+
         arg_params, aux_params = self.get_params()
-        save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-        save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
-        nd.save(fname, save_dict)
+        save_dict = {("arg:%s" % k): nd.NDArray(v._data)
+                     for k, v in arg_params.items()}
+        save_dict.update({("aux:%s" % k): nd.NDArray(v._data)
+                          for k, v in aux_params.items()})
+        engine.push_file_write(fname, lambda: nd.save(fname, save_dict),
+                               wait=not async_write, name="save_params")
 
     def load_params(self, fname):
+        from .. import engine
+
+        engine.wait_for_file(fname)  # an async save may still be in flight
         save_dict = nd.load(fname)
         arg_params = {}
         aux_params = {}

@@ -97,15 +97,19 @@ class Module(BaseModule):
             mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
         return mod
 
-    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        """(reference module.py:135)."""
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        async_write=False):
+        """(reference module.py:135). ``async_write=True`` overlaps the
+        blob writes with continued training (engine-ordered; see
+        engine.push_file_write)."""
         self._symbol.save("%s-symbol.json" % prefix)
         param_name = "%s-%04d.params" % (prefix, epoch)
-        self.save_params(param_name)
+        self.save_params(param_name, async_write=async_write)
         logging.info("Saved checkpoint to \"%s\"", param_name)
         if save_optimizer_states:
             state_name = "%s-%04d.states" % (prefix, epoch)
-            self.save_optimizer_states(state_name)
+            self.save_optimizer_states(state_name,
+                                       async_write=async_write)
             logging.info("Saved optimizer state to \"%s\"", state_name)
 
     # --- properties -------------------------------------------------------
@@ -370,14 +374,22 @@ class Module(BaseModule):
         self._exec_group.get_params(self._arg_params, self._aux_params)
         self._params_dirty = False
 
-    def save_optimizer_states(self, fname):
+    def save_optimizer_states(self, fname, async_write=False):
         assert self.optimizer_initialized
         self._sync_fused_to_exec()
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updater.get_states())
+            from .. import engine
+
+            blob = self._updater.get_states()  # snapshot at call time
+
+            def write():
+                with open(fname, "wb") as fout:
+                    fout.write(blob)
+
+            engine.push_file_write(fname, write, wait=not async_write,
+                                   name="save_optimizer_states")
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
@@ -386,6 +398,9 @@ class Module(BaseModule):
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:
+            from .. import engine
+
+            engine.wait_for_file(fname)
             with open(fname, "rb") as f:
                 self._updater.set_states(f.read())
 

@@ -43,7 +43,7 @@ def load() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(_SO)
         _bind(lib)
     except (OSError, AttributeError):
-        # missing file OR a stale prebuilt .so without the newer symbols:
+        # missing file OR a prebuilt .so lacking even the core symbols:
         # degrade to the pure-Python path rather than crash
         return None
     _lib = lib
@@ -72,12 +72,18 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
         ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
-    lib.mxio_aug_rotate.argtypes = [
-        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
-        ctypes.c_float, ctypes.c_int, ctypes.POINTER(ctypes.c_uint8)]
-    lib.mxio_aug_hsl.argtypes = [
-        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
-        ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    # aug transforms are newer symbols: bind optionally so a stale prebuilt
+    # .so (no toolchain to rebuild) keeps its reader/writer/loader usable
+    try:
+        lib.mxio_aug_rotate.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+            ctypes.c_float, ctypes.c_int, ctypes.POINTER(ctypes.c_uint8)]
+        lib.mxio_aug_hsl.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        lib._mxtpu_has_aug = True
+    except AttributeError:
+        lib._mxtpu_has_aug = False
     lib.mxio_imgloader_next.restype = ctypes.c_int
     lib.mxio_imgloader_next.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
@@ -94,8 +100,9 @@ def aug_rotate(img: np.ndarray, angle: float, fill: int = 255) -> np.ndarray:
     """Native rotation transform on an (H, W, 3) uint8 RGB array (exported
     for golden tests vs image.rotate_image)."""
     lib = load()
-    if lib is None:
-        raise RuntimeError("native io library unavailable")
+    if lib is None or not getattr(lib, "_mxtpu_has_aug", False):
+        raise RuntimeError("native io library unavailable (or too old "
+                           "for aug transforms)")
     img = np.ascontiguousarray(img, np.uint8)
     h, w = img.shape[:2]
     out = np.empty_like(img)
@@ -110,8 +117,9 @@ def aug_hsl(img: np.ndarray, dh: int, ds: int, dl: int) -> np.ndarray:
     """Native HLS-space jitter on an (H, W, 3) uint8 RGB array (exported
     for golden tests vs image.hsl_shift)."""
     lib = load()
-    if lib is None:
-        raise RuntimeError("native io library unavailable")
+    if lib is None or not getattr(lib, "_mxtpu_has_aug", False):
+        raise RuntimeError("native io library unavailable (or too old "
+                           "for aug transforms)")
     out = np.ascontiguousarray(img, np.uint8).copy()
     h, w = out.shape[:2]
     lib.mxio_aug_hsl(out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
@@ -171,6 +179,12 @@ class NativeImageLoader:
         c, h, w = data_shape
         mean = (ctypes.c_float * 3)(*(mean_rgb or (0.0, 0.0, 0.0)))
         std = (ctypes.c_float * 3)(*(std_rgb or (1.0, 1.0, 1.0)))
+        wants_aug = (max_rotate_angle > 0 or rotate > 0 or random_h
+                     or random_s or random_l)
+        if wants_aug and not getattr(lib, "_mxtpu_has_aug", False):
+            # old prebuilt .so: it would silently drop these params — fall
+            # back to the Python reader, which honors them
+            raise RuntimeError("native io library too old for aug params")
         aug = (ctypes.c_int * 6)(int(max_rotate_angle), int(rotate),
                                  int(fill_value), int(random_h),
                                  int(random_s), int(random_l))
