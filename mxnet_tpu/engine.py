@@ -275,6 +275,7 @@ def wait_for_var(var):
 
 def wait_for_all():
     get().wait_for_all()
+    _raise_pending_file_error()
 
 
 # --- file-write routing ------------------------------------------------------
@@ -306,12 +307,11 @@ def push_file_write(path: str, fn: Callable[[], None], wait: bool = True,
     overlaps whatever the caller does next; any exception surfaces at the
     next ``wait_for_file``/``push_file_write`` on the same path."""
     apath = os.path.abspath(path)
-    # surface a previously-recorded failure for this path NOW, so a loop of
-    # async saves can't silently lose every checkpoint after the disk fills
-    with _file_lock:
-        prev = _file_errs.pop(apath, None)
-    if prev is not None:
-        raise prev
+    # surface ANY previously-recorded async-write failure NOW (not just
+    # this path's: per-epoch checkpoints use distinct filenames, and a loop
+    # of async saves must not silently lose every file after the disk
+    # fills)
+    _raise_pending_file_error()
     var = file_var(apath)
 
     def run():
@@ -327,15 +327,45 @@ def push_file_write(path: str, fn: Callable[[], None], wait: bool = True,
         wait_for_file(apath)
 
 
+def _raise_pending_file_error():
+    with _file_lock:
+        if not _file_errs:
+            return
+        path, err = next(iter(_file_errs.items()))
+        del _file_errs[path]
+    raise err
+
+
 def wait_for_file(path: str):
     """Block until every pending engine op on ``path`` finished; re-raise
-    the first failure recorded for it."""
+    the first failure recorded for it. Once drained, the path's engine var
+    is retired (recreated on next use) so long runs with per-epoch
+    filenames don't grow the var table without bound."""
     apath = os.path.abspath(path)
     with _file_lock:
         var = _file_vars.get(apath)
     if var is not None:
         get().wait_for_var(var)
+        with _file_lock:
+            # nothing pending on it anymore: release the native var
+            if _file_vars.get(apath) is var:
+                del _file_vars[apath]
+        get().delete_variable(var)
     with _file_lock:
         err = _file_errs.pop(apath, None)
     if err is not None:
         raise err
+
+
+def wait_for_all_files():
+    """Drain every pending file write and surface the first failure —
+    call at end-of-training when using async_write."""
+    with _file_lock:
+        pending = list(_file_vars.items())
+    for apath, var in pending:
+        get().wait_for_var(var)
+        with _file_lock:
+            if _file_vars.get(apath) is var:
+                del _file_vars[apath]
+        get().delete_variable(var)
+    _raise_pending_file_error()

@@ -400,6 +400,8 @@ class DevicePrefetchIter(DataIter):
         self._engine = engine
         self._iter_var = engine.get().new_variable()
         self._done = False
+        self._wedged = False  # a prefetch op failed to finish in time
+        self._waiter = None   # reusable bounded-wait thread
         self._start()
 
     def _device(self):
@@ -479,17 +481,25 @@ class DevicePrefetchIter(DataIter):
         with self._lock:
             self._gen += 1  # in-queue ops become no-ops
         # bounded wait: a fetch wedged in a device transfer must not hang
-        # reset()/close() (and interpreter shutdown) forever
-        waiter = threading.Thread(
-            target=self._engine.get().wait_for_var, args=(self._iter_var,),
-            daemon=True)
-        waiter.start()
-        waiter.join(timeout=60)
+        # reset()/close() (and interpreter shutdown) forever; once wedged,
+        # later retires re-check briefly (5s) instead of another full 60s,
+        # reusing one waiter thread rather than spawning more
+        waiter = self._waiter
+        if waiter is None or not waiter.is_alive():
+            waiter = threading.Thread(
+                target=self._engine.get().wait_for_var,
+                args=(self._iter_var,), daemon=True)
+            waiter.start()
+            self._waiter = waiter
+        waiter.join(timeout=5 if self._wedged else 60)
         if waiter.is_alive():
+            self._wedged = True
             raise RuntimeError(
                 "DevicePrefetchIter: in-flight prefetch op did not finish "
                 "within 60s; refusing to reuse the base iterator while it "
                 "may still be reading it")
+        self._wedged = False
+        self._waiter = None
         # drop already-produced batches of the retired generation
         try:
             while True:

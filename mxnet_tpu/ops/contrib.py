@@ -101,8 +101,10 @@ def _ifft(attrs, data):
     Matches the reference's unnormalized cuFFT inverse (scaled by d)."""
     d = data.shape[-1] // 2
     x = data.reshape(data.shape[:-1] + (d, 2))
-    c = jax.lax.complex(x[..., 0], x[..., 1])
-    return jnp.fft.ifft(c, axis=-1).real.astype(data.dtype) * d
+    # complex math has no bf16: promote under low-precision compute
+    xf = x.astype(jnp.float32)
+    c = jax.lax.complex(xf[..., 0], xf[..., 1])
+    return (jnp.fft.ifft(c, axis=-1).real * d).astype(data.dtype)
 
 
 # ---------------------------------------------------------------------------

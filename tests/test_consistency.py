@@ -1,0 +1,344 @@
+"""Registry-wide cross-precision / cross-path consistency sweep.
+
+The reference's GPU suite runs every operator across device/precision
+variants via ``check_consistency`` (tests/python/gpu/test_operator_gpu.py,
+python/mxnet/test_utils.py:705: cpu vs gpu vs cudnn vs fp16). The
+TPU-native variant axes are:
+
+1. **f32 vs bf16 compute** — the executor's ``compute_dtype`` mixed-
+   precision path (f32 master weights, bf16 compute, f32 outputs/grads)
+   must stay within bf16 tolerance of the f32 run for EVERY float op.
+2. **Pallas kernels: interpret vs plain XLA** — every kernel in
+   ``ops/pallas`` must match its plain-jnp reference implementation
+   (the cudnn-vs-plain layering contract, cudnn_algoreg-inl.h).
+
+Input construction reuses the registry-wide case builders from
+``test_operator_gradients`` (same shapes/domains), so coverage tracks the
+registry automatically; a completeness gate fails when a float op has
+neither a consistency case nor an explicit, justified skip.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.ops import OP_REGISTRY
+
+from test_operator_gradients import (CUSTOM_BWD, FWD_CASES, GRAD_CASES,
+                                     SKIP, V, _u)
+
+# ---------------------------------------------------------------------------
+# bf16-vs-f32 sweep over the registry cases
+# ---------------------------------------------------------------------------
+
+# ops whose outputs are NOT meaningfully comparable across compute dtypes,
+# each with the reason (mirrors the gradient suite's SKIP discipline)
+BF16_SKIP = {
+    "quantize": "int8 rounding boundaries: one ulp of bf16 input noise "
+                "legally flips a quantized bucket",
+    "dequantize": "inverse of the above; exactness is tested in "
+                  "tests/test_contrib.py against closed-form values",
+    "Proposal": "NMS order: bf16 score noise can reorder near-equal "
+                "proposals (forward-only contrib op; test_detection.py)",
+    "MultiBoxDetection": "same NMS reordering sensitivity",
+    "MultiBoxTarget": "anchor matching argmax over near-equal IoUs",
+    "argsort": "sort order of values closer than one bf16 ulp is "
+               "legitimately unstable across compute dtypes",
+    "topk": "same tie instability as argsort",
+    "_random_uniform": "PRNG bits are generated in the compute dtype: "
+                       "sequences differ by design (freshness is tested "
+                       "in test_random.py)",
+    "_random_normal": "same PRNG dtype dependence",
+    "_random_exponential": "same PRNG dtype dependence",
+    "_random_gamma": "same PRNG dtype dependence",
+}
+
+# forward-compared-only under bf16: the forward is consistent, but the
+# backward routes through comparisons/cell-selection on rounded values, so
+# subgradient choice legitimately differs when bf16 rounding creates ties
+BF16_FWD_ONLY = {
+    "broadcast_maximum": "ties after bf16 rounding flip subgradient routing",
+    "broadcast_minimum": "ties after bf16 rounding flip subgradient routing",
+    "SpatialTransformer": "bilinear cell selection flips when sampling "
+                          "coords round across a pixel boundary",
+}
+
+# per-op tolerance overrides (keyed by registry name before the ":")
+BF16_TOL = {
+    # long reductions / recurrences accumulate bf16 rounding
+    "RNN": dict(atol=8e-2, rtol=8e-2),
+    "ctc_loss": dict(atol=8e-2, rtol=8e-2),
+    "Convolution": dict(atol=6e-2, rtol=6e-2),
+    "Deconvolution": dict(atol=6e-2, rtol=6e-2),
+    "Correlation": dict(atol=6e-2, rtol=6e-2),
+    "fft": dict(atol=6e-2, rtol=6e-2),
+    "ifft": dict(atol=6e-2, rtol=6e-2),
+    "norm": dict(atol=5e-2, rtol=5e-2),
+    "LRN": dict(atol=5e-2, rtol=5e-2),
+    "erfinv": dict(atol=6e-2, rtol=6e-2),   # steep near the domain edge
+    "tan": dict(atol=6e-2, rtol=6e-2),
+    "gamma": dict(atol=6e-2, rtol=6e-2),
+    "count_sketch": dict(atol=6e-2, rtol=6e-2),
+}
+_DEFAULT_TOL = dict(atol=4e-2, rtol=4e-2)
+
+
+def _opname(cid):
+    return cid.split(":")[0]
+
+
+def _run(build, compute_dtype, with_grad):
+    """Forward (+backward with all-ones head grads) under one compute
+    dtype; fresh executor per run, same inputs (numpy from the builder)."""
+    got = build()
+    s, loc = got[0], got[1]
+    if not loc:  # creation ops bind with no args
+        exe = s.bind(mx.cpu(), {}, grad_req="null",
+                     compute_dtype=compute_dtype)
+        outs = exe.forward(is_train=False)
+        return [np.asarray(o.asnumpy(), np.float64) for o in outs], {}
+    grad_req = "write" if with_grad else "null"
+    ctx = mx.cpu()
+    args = {k: nd.array(v, ctx=ctx) for k, v in loc.items()}
+    grads = ({k: nd.zeros(np.shape(v), ctx=ctx) for k, v in loc.items()}
+             if with_grad else None)
+    aux_names = s.list_auxiliary_states()
+    aux = {}
+    if aux_names:
+        shapes = {k: np.shape(v) for k, v in loc.items()}
+        _, _, aux_shapes = s.infer_shape(**shapes)
+        aux = {n: nd.zeros(sh) for n, sh in zip(aux_names, aux_shapes)}
+    exe = s.bind(ctx, args, grads, grad_req, aux,
+                 compute_dtype=compute_dtype)
+    outs = exe.forward(is_train=with_grad)
+    gdict = {}
+    if with_grad:
+        exe.backward([nd.array(np.ones(o.shape, np.float32))
+                      for o in outs])
+        gdict = {k: np.asarray(v.asnumpy(), np.float64)
+                 for k, v in exe.grad_dict.items()}
+    return [np.asarray(o.asnumpy(), np.float64) for o in outs], gdict
+
+
+def _check_case(cid, build, with_grad):
+    op = _opname(cid)
+    if op in BF16_SKIP:
+        pytest.skip("bf16 consistency n/a: %s" % BF16_SKIP[op])
+    if with_grad and op in BF16_FWD_ONLY:
+        with_grad = False
+    # identical inputs for both runs: freeze the builder's randomness
+    state = np.random.get_state()
+    np.random.seed(11)
+    try:
+        import test_operator_gradients as tog
+
+        tog.R.seed(13)
+        o32, g32 = _run(build, None, with_grad)
+        tog.R.seed(13)
+        o16, g16 = _run(build, "bfloat16", with_grad)
+    finally:
+        np.random.set_state(state)
+    tol = BF16_TOL.get(op, _DEFAULT_TOL)
+    for i, (a, b) in enumerate(zip(o32, o16)):
+        np.testing.assert_allclose(
+            a, b, err_msg="%s output %d f32-vs-bf16" % (cid, i), **tol)
+    for k in g32:
+        np.testing.assert_allclose(
+            g32[k], g16[k], err_msg="%s grad %s f32-vs-bf16" % (cid, k),
+            **tol)
+
+
+@pytest.mark.parametrize("cid,build", GRAD_CASES,
+                         ids=[c[0] for c in GRAD_CASES])
+def test_bf16_consistency_grad_ops(cid, build):
+    _check_case(cid, build, with_grad=True)
+
+
+@pytest.mark.parametrize("cid,build", FWD_CASES,
+                         ids=[c[0] for c in FWD_CASES])
+def test_bf16_consistency_forward_ops(cid, build):
+    _check_case(cid, build, with_grad=False)
+
+
+# custom-backward loss family: closed-form backward must also hold in bf16
+_LOSS_CASES = [
+    ("SoftmaxOutput", lambda: (mx.sym.SoftmaxOutput(V("data"), V("label")),
+                               {"data": _u((3, 4)),
+                                "label": np.array([0, 2, 1], np.float32)})),
+    ("LinearRegressionOutput",
+     lambda: (mx.sym.LinearRegressionOutput(V("data"), V("label")),
+              {"data": _u((3, 2)), "label": _u((3, 2))})),
+    ("LogisticRegressionOutput",
+     lambda: (mx.sym.LogisticRegressionOutput(V("data"), V("label")),
+              {"data": _u((3, 2)), "label": _u((3, 2), 0, 1)})),
+    ("MAERegressionOutput",
+     lambda: (mx.sym.MAERegressionOutput(V("data"), V("label")),
+              {"data": _u((3, 2)), "label": _u((3, 2))})),
+    ("SVMOutput", lambda: (mx.sym.SVMOutput(V("data"), V("label")),
+                           {"data": _u((3, 4)),
+                            "label": np.array([0, 2, 1], np.float32)})),
+    ("MakeLoss", lambda: (mx.sym.MakeLoss(V("data"), grad_scale=2.0),
+                          {"data": _u((2, 3), 0.5, 1.5)})),
+    ("BlockGrad", lambda: (mx.sym.BlockGrad(V("data")) * V("w"),
+                           {"data": _u((2, 3)), "w": _u((2, 3))})),
+    ("IdentityAttachKLSparseReg",
+     lambda: (mx.sym.IdentityAttachKLSparseReg(V("data"),
+                                               sparseness_target=0.1,
+                                               penalty=0.01),
+              {"data": _u((2, 4), 0.1, 0.9)})),
+]
+
+
+@pytest.mark.parametrize("cid,build", _LOSS_CASES,
+                         ids=[c[0] for c in _LOSS_CASES])
+def test_bf16_consistency_loss_ops(cid, build):
+    _check_case(cid + ":loss", build, with_grad=True)
+
+
+def test_bf16_registry_coverage_is_complete():
+    """Every distinct float-capable registry op must be covered by a
+    consistency case (via the shared case lists) or carry an explicit
+    skip with a reason — mirroring the gradient suite's gate."""
+    covered = {_opname(cid) for cid, _ in GRAD_CASES}
+    covered |= {_opname(cid) for cid, _ in FWD_CASES}
+    covered |= {cid for cid, _ in _LOSS_CASES}
+    # make_loss/stop_gradient are pure aliases tested through their
+    # canonical names; Custom is per-user-op (test_custom_op.py runs one)
+    covered |= set(CUSTOM_BWD) | set(SKIP) | set(BF16_SKIP)
+
+    uncovered = []
+    seen = set()
+    for name, op in OP_REGISTRY.items():
+        if id(op) in seen:
+            continue
+        seen.add(id(op))
+        aliases = {n for n, o in OP_REGISTRY.items() if o is op}
+        if not (aliases & covered):
+            uncovered.append(sorted(aliases)[0])
+    assert not uncovered, (
+        "registry ops with no f32-vs-bf16 consistency coverage (add a "
+        "case or an explicit BF16_SKIP with a reason): %s"
+        % sorted(uncovered))
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels: interpret-mode kernel vs plain-XLA reference
+# ---------------------------------------------------------------------------
+
+def _plain_attention(q, k, v, causal=False, scale=None):
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / np.sqrt(d)
+    logits = jnp.einsum("...qd,...kd->...qk", q, k) * s
+    if causal:
+        n = logits.shape[-1]
+        mask = np.tril(np.ones((n, n), bool))
+        logits = jnp.where(mask, logits, -1e30)
+    return jnp.einsum("...qk,...kd->...qd", jax.nn.softmax(logits, -1), v)
+
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def test_pallas_flash_attention_matches_plain():
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention
+
+    rng = np.random.RandomState(0)
+    for (B, H, S, D), causal in (((2, 2, 16, 8), False),
+                                 ((1, 2, 32, 8), True)):
+        q = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+        k = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+        v = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+        got = flash_attention(q, k, v, causal=causal, interpret=True)
+        want = _plain_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+        # gradients flow identically through the custom-vjp kernel
+        gk = jax.grad(lambda q: jnp.sum(
+            flash_attention(q, k, v, causal=causal, interpret=True) ** 2))(q)
+        gp = jax.grad(lambda q: jnp.sum(
+            _plain_attention(q, k, v, causal=causal) ** 2))(q)
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(gp),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_pallas_lstm_step_matches_plain():
+    from mxnet_tpu.ops.pallas.lstm import lstm_step
+
+    rng = np.random.RandomState(1)
+    B, Hn = 4, 8
+    ib = jnp.asarray(rng.randn(B, 4 * Hn).astype(np.float32))
+    h = jnp.asarray(rng.randn(B, Hn).astype(np.float32))
+    c = jnp.asarray(rng.randn(B, Hn).astype(np.float32))
+    wh = jnp.asarray(rng.randn(4 * Hn, Hn).astype(np.float32) * 0.1)
+    h2, c2 = lstm_step(ib, h, c, wh, interpret=True)
+    # plain reference: gates = ib + h @ wh^T (wh is (4H, H)), [i,f,g,o]
+    gates = np.asarray(ib) + np.asarray(h) @ np.asarray(wh).T
+    i, f, g, o = np.split(np.asarray(gates), 4, axis=1)
+    sig = lambda x: 1 / (1 + np.exp(-x))  # noqa: E731
+    c_want = sig(f) * np.asarray(c) + sig(i) * np.tanh(g)
+    h_want = sig(o) * np.tanh(c_want)
+    np.testing.assert_allclose(np.asarray(c2), c_want, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h2), h_want, rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_fused_updates_match_plain():
+    from mxnet_tpu.ops.pallas import fused_update as fu
+
+    rng = np.random.RandomState(2)
+    w = rng.randn(16).astype(np.float32)
+    g = rng.randn(16).astype(np.float32)
+    m = rng.randn(16).astype(np.float32)
+    v = rng.rand(16).astype(np.float32) + 0.1
+    lr, mom, wd = 0.1, 0.9, 1e-4
+    w2, m2 = fu.sgd_mom_update(jnp.asarray(w), jnp.asarray(g),
+                               jnp.asarray(m), lr, mom, wd, interpret=True)
+    # MXNet convention (optimizer_op-inl.h): m = mom*m - lr*(g + wd*w);
+    # w += m
+    m_want = mom * m - lr * (g + wd * w)
+    w_want = w + m_want
+    np.testing.assert_allclose(np.asarray(m2), m_want, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(w2), w_want, rtol=1e-5, atol=1e-6)
+
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    w3, m3, v3 = fu.adam_update(jnp.asarray(w), jnp.asarray(g),
+                                jnp.asarray(m), jnp.asarray(v), lr,
+                                beta1=b1, beta2=b2, epsilon=eps,
+                                wd=wd, interpret=True)
+    # reference adam_update: no in-kernel bias correction (the optimizer
+    # folds it into lr)
+    gw = g + wd * w
+    m_want = b1 * m + (1 - b1) * gw
+    v_want = b2 * v + (1 - b2) * gw * gw
+    w_want = w - lr * m_want / (np.sqrt(v_want) + eps)
+    np.testing.assert_allclose(np.asarray(m3), m_want, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(v3), v_want, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(w3), w_want, rtol=1e-4, atol=1e-5)
+
+
+def test_pallas_kernel_coverage_is_complete():
+    """Every public Pallas kernel entry point must have an interpret-vs-
+    plain consistency test above (fails when a kernel is added without
+    one — the must-not-lose fast-path contract needs a correctness
+    anchor first)."""
+    import inspect
+
+    from mxnet_tpu.ops import pallas
+
+    tested = {"flash_attention", "lstm_step", "sgd_mom_update",
+              "adam_update"}
+    helpers = {"on_tpu", "use_for"}  # selection predicates, not kernels
+    public = set()
+    for modname in ("flash_attention", "lstm", "fused_update"):
+        mod = __import__("mxnet_tpu.ops.pallas.%s" % modname,
+                         fromlist=[modname])
+        for name, fn in vars(mod).items():
+            if (inspect.isfunction(fn) and not name.startswith("_")
+                    and fn.__module__ == mod.__name__):
+                public.add(name)
+    missing = public - tested - helpers
+    assert not missing, (
+        "Pallas kernels without an interpret-vs-plain consistency test: %s"
+        % sorted(missing))
