@@ -285,6 +285,7 @@ def wait_for_all():
 # analogue). Writers push with the path's var mutable; readers wait on the
 # var, so an in-flight async checkpoint is never half-read.
 _file_vars: Dict[str, int] = {}
+_file_pending: Dict[str, int] = {}  # writes queued-or-running per path
 _file_errs: Dict[str, BaseException] = {}
 _file_lock = threading.Lock()
 
@@ -304,15 +305,23 @@ def push_file_write(path: str, fn: Callable[[], None], wait: bool = True,
                     name: Optional[str] = None):
     """Run ``fn`` (which writes ``path``) as an engine op holding the
     path's write-var. ``wait=False`` returns immediately — the write
-    overlaps whatever the caller does next; any exception surfaces at the
-    next ``wait_for_file``/``push_file_write`` on the same path."""
+    overlaps whatever the caller does next. A failed async write
+    surfaces at the next ``wait_for_file(path)``, OR at the next
+    ``push_file_write``/``wait_for_all`` on ANY path (per-epoch
+    checkpoints use distinct filenames, so surfacing must not be
+    per-path-only — a full disk would otherwise lose every later
+    checkpoint silently)."""
     apath = os.path.abspath(path)
-    # surface ANY previously-recorded async-write failure NOW (not just
-    # this path's: per-epoch checkpoints use distinct filenames, and a loop
-    # of async saves must not silently lose every file after the disk
-    # fills)
     _raise_pending_file_error()
-    var = file_var(apath)
+    eng = get()
+    with _file_lock:
+        var = _file_vars.get(apath)
+        if var is None:
+            var = eng.new_variable()
+            _file_vars[apath] = var
+        # counted under the SAME lock acquisition that resolved the var,
+        # so wait_for_file can never retire a var with a write en route
+        _file_pending[apath] = _file_pending.get(apath, 0) + 1
 
     def run():
         try:
@@ -320,9 +329,12 @@ def push_file_write(path: str, fn: Callable[[], None], wait: bool = True,
         except BaseException as e:  # surface at the next sync point
             with _file_lock:
                 _file_errs[apath] = e
+        finally:
+            with _file_lock:
+                _file_pending[apath] -= 1
 
-    get().push(run, mutable_vars=[var],
-               name=name or ("file_write:%s" % os.path.basename(apath)))
+    eng.push(run, mutable_vars=[var],
+             name=name or ("file_write:%s" % os.path.basename(apath)))
     if wait:
         wait_for_file(apath)
 
@@ -336,21 +348,29 @@ def _raise_pending_file_error():
     raise err
 
 
+def _retire_file_var(apath: str, var: int):
+    """Drop the path's var ONLY if no write is queued or in flight and the
+    mapping is unchanged (guards the concurrent-writer race); the native
+    delete is itself ordered after the var's enqueued ops."""
+    with _file_lock:
+        if _file_pending.get(apath, 0) != 0 or _file_vars.get(apath) is not var:
+            return
+        del _file_vars[apath]
+        _file_pending.pop(apath, None)
+    get().delete_variable(var)
+
+
 def wait_for_file(path: str):
     """Block until every pending engine op on ``path`` finished; re-raise
-    the first failure recorded for it. Once drained, the path's engine var
-    is retired (recreated on next use) so long runs with per-epoch
-    filenames don't grow the var table without bound."""
+    the first failure recorded for it. Once drained (and only if no new
+    write raced in), the path's engine var is retired so long runs with
+    per-epoch filenames don't grow the var table without bound."""
     apath = os.path.abspath(path)
     with _file_lock:
         var = _file_vars.get(apath)
     if var is not None:
         get().wait_for_var(var)
-        with _file_lock:
-            # nothing pending on it anymore: release the native var
-            if _file_vars.get(apath) is var:
-                del _file_vars[apath]
-        get().delete_variable(var)
+        _retire_file_var(apath, var)
     with _file_lock:
         err = _file_errs.pop(apath, None)
     if err is not None:
@@ -364,8 +384,5 @@ def wait_for_all_files():
         pending = list(_file_vars.items())
     for apath, var in pending:
         get().wait_for_var(var)
-        with _file_lock:
-            if _file_vars.get(apath) is var:
-                del _file_vars[apath]
-        get().delete_variable(var)
+        _retire_file_var(apath, var)
     _raise_pending_file_error()

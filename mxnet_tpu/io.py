@@ -402,6 +402,8 @@ class DevicePrefetchIter(DataIter):
         self._done = False
         self._wedged = False  # a prefetch op failed to finish in time
         self._waiter = None   # reusable bounded-wait thread
+        self._waiter_covers = 0  # ops_pushed snapshot when waiter started
+        self._ops_pushed = 0
         self._start()
 
     def _device(self):
@@ -464,6 +466,7 @@ class DevicePrefetchIter(DataIter):
             except BaseException as e:
                 q.put(e)
 
+        self._ops_pushed += 1
         self._engine.get().push(fetch, mutable_vars=[self._iter_var],
                                 name="prefetch_batch")
 
@@ -480,24 +483,30 @@ class DevicePrefetchIter(DataIter):
         nothing touches the (non-thread-safe) base iterator afterwards."""
         with self._lock:
             self._gen += 1  # in-queue ops become no-ops
-        # bounded wait: a fetch wedged in a device transfer must not hang
-        # reset()/close() (and interpreter shutdown) forever; once wedged,
-        # later retires re-check briefly (5s) instead of another full 60s,
-        # reusing one waiter thread rather than spawning more
+        # Bounded wait: a fetch wedged in a device transfer must not hang
+        # reset()/close() (and interpreter shutdown) forever. A waiter
+        # thread only proves quiescence for ops pushed BEFORE it started
+        # (the native WaitForVar read op is enqueued at call time), so it
+        # is reusable only while no new fetch has been pushed since; then
+        # a wedged retry can re-check briefly instead of a full 60s.
         waiter = self._waiter
-        if waiter is None or not waiter.is_alive():
+        reusable = (waiter is not None and waiter.is_alive()
+                    and self._waiter_covers == self._ops_pushed)
+        if not reusable:
             waiter = threading.Thread(
                 target=self._engine.get().wait_for_var,
                 args=(self._iter_var,), daemon=True)
+            self._waiter_covers = self._ops_pushed
             waiter.start()
             self._waiter = waiter
-        waiter.join(timeout=5 if self._wedged else 60)
+        timeout = 5 if (self._wedged and reusable) else 60
+        waiter.join(timeout=timeout)
         if waiter.is_alive():
             self._wedged = True
             raise RuntimeError(
                 "DevicePrefetchIter: in-flight prefetch op did not finish "
-                "within 60s; refusing to reuse the base iterator while it "
-                "may still be reading it")
+                "within %ds; refusing to reuse the base iterator while it "
+                "may still be reading it" % timeout)
         self._wedged = False
         self._waiter = None
         # drop already-produced batches of the retired generation

@@ -324,6 +324,7 @@ def test_pallas_kernel_coverage_is_complete():
     one — the must-not-lose fast-path contract needs a correctness
     anchor first)."""
     import inspect
+    import pkgutil
 
     from mxnet_tpu.ops import pallas
 
@@ -331,9 +332,11 @@ def test_pallas_kernel_coverage_is_complete():
               "adam_update"}
     helpers = {"on_tpu", "use_for"}  # selection predicates, not kernels
     public = set()
-    for modname in ("flash_attention", "lstm", "fused_update"):
-        mod = __import__("mxnet_tpu.ops.pallas.%s" % modname,
-                         fromlist=[modname])
+    # enumerate the PACKAGE, not a hardcoded list, so a kernel added in a
+    # new ops/pallas module cannot escape the gate
+    for info in pkgutil.iter_modules(pallas.__path__):
+        mod = __import__("mxnet_tpu.ops.pallas.%s" % info.name,
+                         fromlist=[info.name])
         for name, fn in vars(mod).items():
             if (inspect.isfunction(fn) and not name.startswith("_")
                     and fn.__module__ == mod.__name__):

@@ -94,3 +94,21 @@ def test_rcnn_demo_example():
 def test_dcgan_example():
     out = _run("examples/gan/dcgan.py", "--batches", "5")
     assert "dcgan alternating training ran 5 batches OK" in out
+
+
+def test_warpctc_lstm_ocr_example():
+    """CTC training end-to-end (reference example/warpctc/lstm_ocr.py):
+    LSTM -> ctc_loss -> MakeLoss, loss decreasing on synthetic digit
+    strings."""
+    out = _run("examples/warpctc/lstm_ocr.py", "--steps", "8")
+    assert "decreasing" in out and "NOT decreasing" not in out
+
+
+def test_nce_loss_example():
+    """NCE training at 10k+ vocab (reference example/nce-loss/toy_nce.py):
+    Embedding gather/scatter backward at vocabulary scale, loss
+    decreasing."""
+    out = _run("examples/nce-loss/toy_nce.py", "--steps", "20",
+               "--vocab", "12000")
+    assert "decreasing" in out and "NOT decreasing" not in out
+    assert "vocab 12000" in out
