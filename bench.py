@@ -34,10 +34,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# Default batch 256: the TPU-idiomatic per-chip batch (the reference's
-# table is bs32-per-GPU; BENCH_BATCH=32 reproduces that config — both are
-# recorded in the JSON via the metric name).
-BATCH = int(os.environ.get("BENCH_BATCH", "256"))
+# Default batch 128: the measured per-chip optimum on v5e (BENCH_SWEEP=1
+# table in docs/perf.md — bs128 beats bs256 by ~1.4pp MFU; the reference's
+# table is bs32-per-GPU and BENCH_BATCH=32 reproduces that config — every
+# batch is recorded in the JSON via the metric name).
+BATCH = int(os.environ.get("BENCH_BATCH", "128"))
 P100_IMGS_PER_SEC = 181.53  # reference ResNet-50 training @bs32
 MFU_TARGET = 0.45           # BASELINE.md north star
 WARMUP = 3
@@ -45,7 +46,9 @@ ITERS = int(os.environ.get("BENCH_ITERS", "100"))
 REPEATS = max(1, int(float(os.environ.get("BENCH_REPEATS", "5"))))
 
 
-def main():
+def run_config(batch, iters=None, repeats=None, remat=False):
+    """Measure one (batch, remat) training config; returns the record
+    dict. Used by the headline run and the BENCH_SWEEP table."""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -53,8 +56,14 @@ def main():
     from mxnet_tpu import flops as flops_mod
     from mxnet_tpu import models
 
+    if remat:
+        os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
+    else:
+        os.environ.pop("MXNET_BACKWARD_DO_MIRROR", None)
+    iters = iters or ITERS
+    repeats = repeats or REPEATS
     sym = models.get_symbol("resnet-50", num_classes=1000)
-    data_shape = (BATCH, 3, 224, 224)
+    data_shape = (batch, 3, 224, 224)
     # bf16 compute / f32 master weights: the MXU-native mixed-precision path
     # (executor compute_dtype; override with BENCH_DTYPE=float32).
     cdtype = os.environ.get("BENCH_DTYPE", "bfloat16")
@@ -67,7 +76,7 @@ def main():
     exe = sym.simple_bind(mx.Context("tpu", 0) if jax.default_backend() != "cpu"
                           else mx.cpu(), grad_req=grad_req,
                           compute_dtype=cdtype,
-                          data=data_shape, softmax_label=(BATCH,))
+                          data=data_shape, softmax_label=(batch,))
     # init weights
     init = mx.initializer.Xavier(factor_type="in", magnitude=2.0)
     for name, arr in exe.arg_dict.items():
@@ -77,7 +86,7 @@ def main():
 
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.uniform(-1, 1, data_shape).astype(np.float32))
-    y = jnp.asarray(rng.randint(0, 1000, (BATCH,)).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 1000, (batch,)).astype(np.float32))
 
     lr, momentum, wd = 0.05, 0.9, 1e-4
     param_names = [n for n in exe.arg_dict if n not in ("data", "softmax_label")]
@@ -113,27 +122,27 @@ def main():
     # run-to-run contention noise; median is robust without the
     # optimistic bias of best-of-N)
     block_times = []
-    for _ in range(REPEATS):
+    for _ in range(repeats):
         t0 = time.perf_counter()
-        for _ in range(ITERS):
+        for _ in range(iters):
             outs, params, moms = step(params, moms, feed)
         sync()
         block_times.append(time.perf_counter() - t0)
-    step_time = statistics.median(block_times) / ITERS
+    step_time = statistics.median(block_times) / iters
 
     per_iter_ms = None
     if os.environ.get("BENCH_PER_ITER"):
         # cross-check: per-step wall time with a sync EVERY step (upper
         # bound: includes one dispatch+readback latency per step)
         ts = []
-        for _ in range(min(ITERS, 30)):
+        for _ in range(min(iters, 30)):
             t0 = time.perf_counter()
             outs, params, moms = step(params, moms, feed)
             sync()
             ts.append(time.perf_counter() - t0)
         per_iter_ms = round(statistics.median(ts) * 1e3, 3)
 
-    imgs_per_sec = BATCH / step_time
+    imgs_per_sec = batch / step_time
 
     fwd_flops_img = flops_mod.count_flops(
         sym, data=(1, 3, 224, 224), softmax_label=(1,))["total"]
@@ -148,7 +157,7 @@ def main():
     mfu = achieved / peak if (peak and cdtype == "bfloat16") else None
 
     rec = {
-        "metric": "resnet50_train_mfu_bs%d" % BATCH,
+        "metric": "resnet50_train_mfu_bs%d" % batch,
         "value": round(100.0 * mfu, 2) if mfu is not None else round(imgs_per_sec, 2),
         "unit": "percent_of_bf16_peak" if mfu is not None else "images/sec",
         "vs_baseline": round(mfu / MFU_TARGET, 3) if mfu is not None
@@ -163,14 +172,48 @@ def main():
         "chip_peak_tflops": round(peak / 1e12, 1) if peak else None,
         "achieved_tflops": round(achieved / 1e12, 2),
         "timing": "median of %d blocks x %d iters, readback sync" % (
-            REPEATS, ITERS),
+            repeats, iters),
         "compute_dtype": cdtype,
     }
+    if remat:
+        rec["metric"] += "_remat"
+        rec["remat"] = "MXNET_BACKWARD_DO_MIRROR segments"
     if mfu is None:
-        rec["metric"] = "resnet50_train_imgs_per_sec_bs%d" % BATCH
+        rec["metric"] = rec["metric"].replace("_mfu_", "_imgs_per_sec_")
     if per_iter_ms is not None:
         rec["per_iter_ms_synced"] = per_iter_ms
-    print(json.dumps(rec))
+    return rec
+
+
+def main():
+    if os.environ.get("BENCH_SWEEP"):
+        # MFU-vs-batch table (one JSON line per config; the HEADLINE
+        # config's line is re-printed LAST so the driver's
+        # read-the-last-line contract records the bs128 default, not
+        # whichever sweep row happened to finish last). bs1024 needs
+        # segmented remat to fit HBM (docs/note_memory.md).
+        sweep = [(32, False), (128, False), (256, False), (512, False),
+                 (1024, True)]
+        rows = []
+        for batch, remat in sweep:
+            iters = max(10, min(ITERS, 8192 // batch))
+            try:
+                rec = run_config(batch, iters=iters, repeats=3, remat=remat)
+            except Exception as e:  # OOM etc.: record, keep sweeping
+                rec = {"metric": "resnet50_train_mfu_bs%d%s" % (
+                           batch, "_remat" if remat else ""),
+                       "error": "%s: %s" % (type(e).__name__, e)}
+            rows.append(rec)
+            print(json.dumps(rec), flush=True)
+        # headline = the default-BATCH row regardless of metric flavor
+        # (img/s fallback included); else the first healthy row
+        ok = [r for r in rows if "error" not in r]
+        headline = next((r for r in ok
+                         if r["metric"].endswith("_bs%d" % BATCH)),
+                        ok[0] if ok else rows[-1])
+        print(json.dumps(headline))
+        return
+    print(json.dumps(run_config(BATCH)))
 
 
 if __name__ == "__main__":

@@ -3,14 +3,28 @@
 # SURVEY §2.7/§4.7). Stages mirror the reference's: build native libs,
 # unit suite on the virtual 8-device CPU mesh, multi-chip dry-run compile,
 # example smoke runs (included in the suite), lint-lite.
+#
+# Tiers (reference unittest-vs-nightly split, SURVEY §4):
+#   ci/run_tests.sh          quick tier: everything except the exhaustive
+#                            registry sweeps (completeness gates included)
+#   ci/run_tests.sh --full   nightly tier: the whole suite
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+TIER="quick"
+if [[ "${1:-}" == "--full" ]]; then
+    TIER="full"
+fi
 
 echo "== stage 1: native build =="
 make -C native -j"$(nproc)"
 
-echo "== stage 2: unit + integration suite (virtual 8-device CPU mesh) =="
-python -m pytest tests/ -q
+echo "== stage 2: unit + integration suite ($TIER tier, virtual 8-device CPU mesh) =="
+if [[ "$TIER" == "quick" ]]; then
+    python -m pytest tests/ -q -m "not slow"
+else
+    python -m pytest tests/ -q
+fi
 
 echo "== stage 3: multi-chip sharding dry-run (8 virtual devices) =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Transformer benchmarks: flash-attention fast path + LM training.
+
+Two measurements (the cuDNN-fast-path layering extended to attention,
+SURVEY §7 / cudnn_rnn-inl.h:22 contract — the fast path must not lose
+where it is selected):
+
+1. micro: the Pallas flash-attention kernel
+   (ops/pallas/flash_attention.py) vs the plain XLA einsum attention
+   (ops/attention.py dot_product_attention) at several (batch, heads,
+   seq, head_dim) shapes, forward pass, bf16 — plus an on-chip numeric
+   equivalence check (the kernel is otherwise only correctness-tested in
+   interpret mode on CPU).
+2. decoder-only transformer-LM training throughput (models/transformer
+   blocks with a scalar-loss head; head_dim 128 so the flash path is
+   selected), flash on vs off in the SAME training program.
+
+    python examples/transformer/bench_transformer.py
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+from common import respect_jax_platforms  # noqa: E402
+respect_jax_platforms()
+
+
+def micro(args):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import attention as att
+    from mxnet_tpu.ops.pallas import flash_attention as fa
+
+    # off-TPU (CPU smoke) the kernel runs in interpret mode at tiny shapes
+    on_cpu = jax.default_backend() == "cpu"
+    interp = True if on_cpu else False
+    shapes = ([(1, 2, 256, 128)] if on_cpu else
+              [(8, 16, 2048, 128), (4, 8, 4096, 128), (8, 16, 512, 128)])
+    rows = []
+    for (B, H, S, D) in shapes:
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32),
+                        dtype=jnp.bfloat16)
+        k = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32),
+                        dtype=jnp.bfloat16)
+        v = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32),
+                        dtype=jnp.bfloat16)
+
+        flash_full = jax.jit(lambda q, k, v: fa.flash_attention(
+            q, k, v, causal=args.causal, interpret=interp))
+        plain_full = jax.jit(lambda q, k, v: att.dot_product_attention(
+            q, k, v, causal=args.causal))
+        # timing closures reduce to a SCALAR: a fresh (B,H,S,D) output
+        # buffer per execution costs ~160 ms/45 MB through the dev tunnel
+        # (docs/perf.md LSTM caveat) and would swamp the kernel time
+        flash = jax.jit(lambda q, k, v: jnp.sum(fa.flash_attention(
+            q, k, v, causal=args.causal, interpret=interp)
+            .astype(jnp.float32)))
+        plain = jax.jit(lambda q, k, v: jnp.sum(att.dot_product_attention(
+            q, k, v, causal=args.causal).astype(jnp.float32)))
+
+        # on-chip numeric equivalence (f32 softmax inside both paths)
+        of = np.asarray(flash_full(q, k, v), np.float32)
+        op = np.asarray(plain_full(q, k, v), np.float32)
+        maxdiff = np.abs(of - op).max()
+
+        def timeit(f, reps=3 if on_cpu else 200):
+            r = f(q, k, v)
+            np.asarray(jnp.reshape(r, (-1,))[0])
+            best = None
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    r = f(q, k, v)
+                np.asarray(jnp.reshape(r, (-1,))[0])
+                t = (time.perf_counter() - t0) / reps
+                best = t if best is None else min(best, t)
+            return best
+
+        t_plain = timeit(plain)
+        t_flash = timeit(flash)
+        # attention FLOPs: 2 matmuls of 2*B*H*S*S*D each (causal halves)
+        flops = 4 * B * H * S * S * D * (0.5 if args.causal else 1.0)
+        rows.append((B, H, S, D, t_plain, t_flash, maxdiff))
+        print("micro B=%d H=%d S=%d D=%d causal=%s: plain %.3f ms "
+              "(%.0f TF/s)  flash %.3f ms (%.0f TF/s)  speedup %.2fx  "
+              "maxdiff %.4f"
+              % (B, H, S, D, args.causal, t_plain * 1e3,
+                 flops / t_plain / 1e12, t_flash * 1e3,
+                 flops / t_flash / 1e12, t_plain / t_flash, maxdiff))
+    return rows
+
+
+def _lm_symbol(vocab, num_layers, num_heads, dm, dff, use_flash):
+    """Decoder-only LM (models/transformer blocks, use_flash switchable)
+    with a SCALAR loss head — on tunneled devices a (batch*seq, vocab)
+    probability output costs a per-step fresh-buffer round trip that has
+    nothing to do with the model (docs/perf.md LSTM caveat)."""
+    import mxnet_tpu as mx
+
+    sym = mx.sym
+    data = sym.Variable("data")
+    x = sym.Embedding(data=data, input_dim=vocab, output_dim=dm,
+                      name="embed")
+    for i in range(num_layers):
+        name = "layer%d" % i
+        ln1_g = sym.Variable(name + "_ln1_gamma", shape=(dm,))
+        ln1_b = sym.Variable(name + "_ln1_beta", shape=(dm,))
+        h = sym.LayerNorm(data=x, gamma=ln1_g, beta=ln1_b,
+                          name=name + "_ln1")
+        q = sym.FullyConnected(data=h, num_hidden=dm, flatten=False,
+                               no_bias=True, name=name + "_q")
+        k = sym.FullyConnected(data=h, num_hidden=dm, flatten=False,
+                               no_bias=True, name=name + "_k")
+        v = sym.FullyConnected(data=h, num_hidden=dm, flatten=False,
+                               no_bias=True, name=name + "_v")
+        a = sym.MultiHeadAttention(query=q, key=k, value=v,
+                                   num_heads=num_heads, causal=True,
+                                   use_rope=True, use_flash=use_flash,
+                                   name=name + "_attn")
+        a = sym.FullyConnected(data=a, num_hidden=dm, flatten=False,
+                               no_bias=True, name=name + "_o")
+        x = x + a
+        ln2_g = sym.Variable(name + "_ln2_gamma", shape=(dm,))
+        ln2_b = sym.Variable(name + "_ln2_beta", shape=(dm,))
+        h = sym.LayerNorm(data=x, gamma=ln2_g, beta=ln2_b,
+                          name=name + "_ln2")
+        h = sym.FullyConnected(data=h, num_hidden=dff, flatten=False,
+                               name=name + "_ffn1")
+        h = sym.Activation(data=h, act_type="gelu", name=name + "_gelu")
+        h = sym.FullyConnected(data=h, num_hidden=dm, flatten=False,
+                               name=name + "_ffn2")
+        x = x + h
+    lnf_g = sym.Variable("lnf_gamma", shape=(dm,))
+    lnf_b = sym.Variable("lnf_beta", shape=(dm,))
+    x = sym.LayerNorm(data=x, gamma=lnf_g, beta=lnf_b, name="lnf")
+    pred = sym.Reshape(data=x, shape=(-1, dm))
+    pred = sym.FullyConnected(data=pred, num_hidden=vocab, name="pred")
+    logp = sym.log_softmax(pred, axis=-1)
+    label = sym.Reshape(sym.Variable("softmax_label"), shape=(-1,))
+    onehot = sym.one_hot(label, depth=vocab)
+    nll = sym._mul_scalar(sym.mean(sym.sum(sym._mul(logp, onehot), axis=1)),
+                          scalar=-1.0)
+    return sym.MakeLoss(nll, name="loss")
+
+
+def lm_train(args, use_flash):
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+
+    N, T = args.batch_size, args.seq_len
+    sym = _lm_symbol(args.vocab, args.num_layers, args.num_heads,
+                     args.model_dim, 4 * args.model_dim, use_flash)
+    dev = (mx.Context("tpu", 0) if jax.default_backend() != "cpu"
+           else mx.cpu())
+    mod = mx.mod.Module(sym, context=dev,
+                        compute_dtype=os.environ.get("BENCH_DTYPE",
+                                                     "bfloat16"))
+    mod.bind(data_shapes=[("data", (N, T))],
+             label_shapes=[("softmax_label", (N, T))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    rng = np.random.RandomState(0)
+    batch = mx.io.DataBatch(
+        [mx.nd.array(rng.randint(0, args.vocab, (N, T)).astype(np.float32))],
+        [mx.nd.array(rng.randint(0, args.vocab, (N, T)).astype(np.float32))])
+
+    def sync():
+        np.asarray(mod.get_outputs()[0].asnumpy().reshape(-1)[0])
+
+    for _ in range(3):
+        mod.fit_step(batch)
+    sync()
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            mod.fit_step(batch)
+        sync()
+        times.append((time.perf_counter() - t0) / args.steps)
+    t = sorted(times)[len(times) // 2]
+    print("transformer-lm(flash=%s) L=%d dm=%d heads=%d vocab=%d bs=%d "
+          "seq=%d: %.2f ms/step  %.0f tokens/s"
+          % (use_flash, args.num_layers, args.model_dim, args.num_heads,
+             args.vocab, N, T, t * 1e3, N * T / t))
+    return t
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=1024)
+    p.add_argument("--vocab", type=int, default=10000)
+    p.add_argument("--num-layers", type=int, default=4)
+    p.add_argument("--num-heads", type=int, default=8)
+    p.add_argument("--model-dim", type=int, default=1024,
+                   help="head_dim = model_dim/num_heads; 1024/8 = 128 "
+                        "selects the flash kernel")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--causal", action=argparse.BooleanOptionalAction,
+                   default=True)
+    p.add_argument("--skip-micro", action="store_true")
+    p.add_argument("--skip-train", action="store_true")
+    args = p.parse_args()
+    if not args.skip_micro:
+        micro(args)
+    if not args.skip_train:
+        t_flash = lm_train(args, use_flash=True)
+        t_plain = lm_train(args, use_flash=False)
+        print("flash-vs-plain in training: %.2fx" % (t_plain / t_flash))
+
+
+if __name__ == "__main__":
+    main()

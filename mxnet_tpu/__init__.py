@@ -35,7 +35,7 @@ _TRAINING_SURFACE = frozenset((
     "test_utils", "model", "FeedForward", "executor_manager",
     "kvstore_server", "operator", "models", "recordio", "rtc", "engine",
     "rnn", "profiler", "image", "registry", "log", "libinfo", "contrib",
-    "notebook", "plugins", "misc", "torch", "th",
+    "notebook", "plugins", "misc", "torch", "th", "filesystem",
 ))
 
 if not _PREDICT_ONLY:
@@ -75,6 +75,7 @@ if not _PREDICT_ONLY:
     from . import notebook
     from . import plugins
     from . import misc
+    from . import filesystem
 
 
 def __getattr__(name):

@@ -286,6 +286,7 @@ def wait_for_all():
 # var, so an in-flight async checkpoint is never half-read.
 _file_vars: Dict[str, int] = {}
 _file_pending: Dict[str, int] = {}  # writes queued-or-running per path
+_file_waiting: Dict[str, int] = {}  # waiters pinning the var per path
 _file_errs: Dict[str, BaseException] = {}
 _file_lock = threading.Lock()
 
@@ -349,11 +350,14 @@ def _raise_pending_file_error():
 
 
 def _retire_file_var(apath: str, var: int):
-    """Drop the path's var ONLY if no write is queued or in flight and the
-    mapping is unchanged (guards the concurrent-writer race); the native
-    delete is itself ordered after the var's enqueued ops."""
+    """Drop the path's var ONLY if no write is queued/in flight, no other
+    waiter holds it, and the mapping is unchanged (guards the concurrent
+    writer AND concurrent waiter races); the native delete is itself
+    ordered after the var's enqueued ops."""
     with _file_lock:
-        if _file_pending.get(apath, 0) != 0 or _file_vars.get(apath) is not var:
+        if (_file_pending.get(apath, 0) != 0
+                or _file_waiting.get(apath, 0) != 0
+                or _file_vars.get(apath) is not var):
             return
         del _file_vars[apath]
         _file_pending.pop(apath, None)
@@ -363,13 +367,22 @@ def _retire_file_var(apath: str, var: int):
 def wait_for_file(path: str):
     """Block until every pending engine op on ``path`` finished; re-raise
     the first failure recorded for it. Once drained (and only if no new
-    write raced in), the path's engine var is retired so long runs with
-    per-epoch filenames don't grow the var table without bound."""
+    write or other waiter raced in), the path's engine var is retired so
+    long runs with per-epoch filenames don't grow the var table without
+    bound."""
     apath = os.path.abspath(path)
     with _file_lock:
         var = _file_vars.get(apath)
+        if var is not None:
+            # pin: a concurrent wait_for_file must not retire+delete the
+            # var between our lookup and the native wait
+            _file_waiting[apath] = _file_waiting.get(apath, 0) + 1
     if var is not None:
-        get().wait_for_var(var)
+        try:
+            get().wait_for_var(var)
+        finally:
+            with _file_lock:
+                _file_waiting[apath] -= 1
         _retire_file_var(apath, var)
     with _file_lock:
         err = _file_errs.pop(apath, None)
@@ -381,8 +394,7 @@ def wait_for_all_files():
     """Drain every pending file write and surface the first failure —
     call at end-of-training when using async_write."""
     with _file_lock:
-        pending = list(_file_vars.items())
-    for apath, var in pending:
-        get().wait_for_var(var)
-        _retire_file_var(apath, var)
+        pending = list(_file_vars)
+    for apath in pending:
+        wait_for_file(apath)  # raises the path's recorded error, if any
     _raise_pending_file_error()

@@ -1,0 +1,138 @@
+"""Pluggable byte-stream openers for dataset URIs.
+
+The reference reads .rec data through dmlc::Stream, whose URI schemes
+(file://, s3://, hdfs://) are compile-time plugins (make/config.mk:132-144
+USE_S3/USE_HDFS). The TPU-native equivalent is a runtime scheme registry:
+``open_stream(uri, mode)`` dispatches on ``scheme://`` to a registered
+opener returning a file-like object, so ``MXRecordIO`` (and everything
+above it: ImageRecordIter, im2rec, checkpoints that go through it) can
+read records from object storage without the framework knowing the
+backend.
+
+Built-ins:
+- plain paths / ``file://`` — local filesystem
+- ``memory://`` — an in-process byte store (tests, fixtures, ephemeral
+  shards)
+- any scheme fsspec knows (``gs://``, ``s3://``, ...) IF fsspec is
+  importable — the runtime analogue of the reference's USE_S3 build flag;
+  absent fsspec, those schemes raise with a clear message.
+
+Register custom backends with ``register_stream_opener``.
+"""
+from __future__ import annotations
+
+import io
+import threading
+from typing import Callable, Dict
+
+from .base import MXNetError
+
+_OPENERS: Dict[str, Callable] = {}
+_MEMORY_FS: Dict[str, bytes] = {}
+_MEMORY_LOCK = threading.Lock()
+
+
+def register_stream_opener(scheme: str, opener: Callable):
+    """opener(uri, mode) -> binary file-like. Registering an existing
+    scheme replaces it (last wins, like dmlc registry overrides)."""
+    _OPENERS[scheme] = opener
+
+
+def split_scheme(uri: str):
+    """('scheme', uri) — scheme '' for plain local paths. A Windows drive
+    letter is not a scheme."""
+    if "://" in uri:
+        scheme = uri.split("://", 1)[0]
+        if len(scheme) > 1:
+            return scheme, uri
+    return "", uri
+
+
+def open_stream(uri: str, mode: str = "rb"):
+    """Open ``uri`` for binary reading/writing via the scheme registry."""
+    scheme, uri = split_scheme(uri)
+    opener = _OPENERS.get(scheme)
+    if opener is None:
+        raise MXNetError(
+            "no stream opener for scheme %r (uri %r); register one with "
+            "mxnet_tpu.filesystem.register_stream_opener — remote schemes "
+            "(gs/s3/...) need fsspec installed" % (scheme, uri))
+    return opener(uri, mode)
+
+
+def exists(uri: str) -> bool:
+    """Existence probe across schemes (os.path.isfile for local)."""
+    scheme, _ = split_scheme(uri)
+    if scheme in ("", "file"):
+        import os
+
+        return os.path.isfile(uri[7:] if uri.startswith("file://") else uri)
+    if scheme == "memory":
+        with _MEMORY_LOCK:
+            return uri in _MEMORY_FS
+    try:
+        with open_stream(uri, "rb"):
+            return True
+    except Exception:
+        return False
+
+
+# --- built-in openers -------------------------------------------------------
+
+def _open_local(uri, mode):
+    if uri.startswith("file://"):
+        uri = uri[7:]
+    return open(uri, mode)
+
+
+class _MemoryWriter(io.BytesIO):
+    """Commits its bytes to the in-process store on close."""
+
+    def __init__(self, key):
+        super().__init__()
+        self._key = key
+
+    def close(self):
+        if not self.closed:
+            with _MEMORY_LOCK:
+                _MEMORY_FS[self._key] = self.getvalue()
+        super().close()
+
+
+def _open_memory(uri, mode):
+    if "w" in mode:
+        return _MemoryWriter(uri)
+    with _MEMORY_LOCK:
+        data = _MEMORY_FS.get(uri)
+    if data is None:
+        raise FileNotFoundError(uri)
+    return io.BytesIO(data)
+
+
+def memory_fs_clear():
+    """Drop every memory:// object (test isolation)."""
+    with _MEMORY_LOCK:
+        _MEMORY_FS.clear()
+
+
+def _open_fsspec(uri, mode):
+    try:
+        import fsspec
+    except ImportError:
+        raise MXNetError(
+            "uri %r needs fsspec for its scheme (the runtime analogue of "
+            "the reference's USE_S3/USE_HDFS build flags); pip install "
+            "fsspec + the scheme's backend" % uri) from None
+    try:
+        return fsspec.open(uri, mode).open()
+    except ImportError as e:  # fsspec present, scheme backend missing
+        raise MXNetError(
+            "uri %r: fsspec lacks this scheme's backend (%s)"
+            % (uri, e)) from e
+
+
+register_stream_opener("", _open_local)
+register_stream_opener("file", _open_local)
+register_stream_opener("memory", _open_memory)
+for _scheme in ("gs", "s3", "hdfs", "http", "https", "az", "abfs"):
+    register_stream_opener(_scheme, _open_fsspec)

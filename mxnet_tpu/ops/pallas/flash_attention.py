@@ -26,6 +26,12 @@ except ImportError:  # pragma: no cover
 
 BLOCK_Q = 256
 BLOCK_K = 256
+# Selection gate (the cudnn-autotune "must not lose" contract): measured
+# on v5e (examples/transformer/bench_transformer.py micro), the kernel is
+# 2.2x at S=2048 and 5.4x at S=4096 but 0.91x at S=512 — short sequences
+# amortize the kernel's per-block softmax bookkeeping worse than XLA's
+# fused einsum. Gate to sequences where it measurably wins.
+MIN_SEQ = 1024
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
@@ -144,13 +150,18 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if interpret is None:
-        interpret = False
-        if not on_tpu():
+        # auto mode: the kernel is SELECTED only on TPU with aligned
+        # shapes at sequence lengths where it measurably wins
+        if (not on_tpu()
+                or not (_aligned(q.shape[-2], BLOCK_Q)
+                        and _aligned(k.shape[-2], BLOCK_K)
+                        and q.shape[-1] % 128 == 0
+                        and q.shape[-2] >= MIN_SEQ)):
             return _att.dot_product_attention(q, k, v, causal=causal,
                                               scale=scale)
-    if not (_aligned(q.shape[-2], BLOCK_Q) and _aligned(k.shape[-2], BLOCK_K)
-            and q.shape[-1] % 128 == 0 and q.shape[-2] >= 8):
-        return _att.dot_product_attention(q, k, v, causal=causal, scale=scale)
+        interpret = False
+    # explicit interpret=True/False forces the kernel (tests and benches
+    # must exercise IT, not the fallback)
 
     b, h, tq, d = q.shape
     tk = k.shape[2]

@@ -27,7 +27,11 @@ IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
 
 
 class MXRecordIO:
-    """Sequential reader/writer (reference recordio.py MXRecordIO)."""
+    """Sequential reader/writer (reference recordio.py MXRecordIO).
+
+    ``uri`` goes through the scheme registry (mxnet_tpu.filesystem — the
+    dmlc::Stream s3://hdfs:// seam), so records can live in object storage
+    or the in-process ``memory://`` store, not just local files."""
 
     def __init__(self, uri, flag):
         self.uri = uri
@@ -36,11 +40,13 @@ class MXRecordIO:
         self.open()
 
     def open(self):
+        from .filesystem import open_stream
+
         if self.flag == "w":
-            self.fp = open(self.uri, "wb")
+            self.fp = open_stream(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.fp = open(self.uri, "rb")
+            self.fp = open_stream(self.uri, "rb")
             self.writable = False
         else:
             raise ValueError("Invalid flag %s" % self.flag)
@@ -98,13 +104,17 @@ class MXIndexedRecordIO(MXRecordIO):
         super().__init__(uri, flag)
 
     def open(self):
+        from .filesystem import exists, open_stream
+
         super().open()
         self.idx = {}
         self.keys = []
-        if not self.writable and os.path.isfile(self.idx_path):
-            with open(self.idx_path) as fin:
-                for line in fin:
+        if not self.writable and exists(self.idx_path):
+            with open_stream(self.idx_path, "rb") as fin:
+                for line in fin.read().decode().splitlines():
                     line = line.strip().split("\t")
+                    if len(line) < 2:
+                        continue
                     key = self.key_type(line[0])
                     self.idx[key] = int(line[1])
                     self.keys.append(key)
@@ -113,9 +123,12 @@ class MXIndexedRecordIO(MXRecordIO):
         if not self.is_open:
             return
         if self.writable:
-            with open(self.idx_path, "w") as fout:
+            from .filesystem import open_stream
+
+            with open_stream(self.idx_path, "wb") as fout:
                 for key in self.keys:
-                    fout.write("%s\t%d\n" % (str(key), self.idx[key]))
+                    fout.write(("%s\t%d\n" % (str(key),
+                                              self.idx[key])).encode())
         super().close()
 
     def seek(self, idx):

@@ -112,3 +112,12 @@ def test_nce_loss_example():
                "--vocab", "12000")
     assert "decreasing" in out and "NOT decreasing" not in out
     assert "vocab 12000" in out
+
+
+def test_transformer_bench_example():
+    """Attention fast-path bench harness runs end-to-end on the CPU mesh
+    (tiny config; real numbers come from the chip — docs/perf.md)."""
+    out = _run("examples/transformer/bench_transformer.py",
+               "--num-layers", "1", "--model-dim", "256", "--num-heads", "2",
+               "--seq-len", "256", "--batch-size", "2", "--steps", "2")
+    assert "micro" in out and "flash-vs-plain" in out

@@ -142,6 +142,8 @@ def test_compute_dtype_bf16_mixed_precision():
     exe32 = net.simple_bind(mx.cpu(), data=(4, 6), softmax_label=(4,))
     exe16 = net.simple_bind(mx.cpu(), compute_dtype="bfloat16",
                             data=(4, 6), softmax_label=(4,))
+    np.random.seed(42)  # Xavier draws from the GLOBAL rng: pin it, or the
+    #   bf16-vs-f32 margins depend on how many draws earlier tests made
     init = mx.initializer.Xavier()
     for n, a in exe32.arg_dict.items():
         if n in ("data", "softmax_label"):

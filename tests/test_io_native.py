@@ -384,3 +384,63 @@ def test_record_iter_rotation_and_hsl(tmp_path, monkeypatch):
         monkeypatch.setattr(native, "_tried", True)
         rot_py = batch_of(rotate=37)
         assert np.abs(rot - rot_py).mean() < 2.0
+
+
+# --- pluggable record streams (reference dmlc::Stream s3/hdfs seam,
+# make/config.mk:132-144) ----------------------------------------------------
+
+def test_memory_stream_recordio_roundtrip():
+    from mxnet_tpu import filesystem
+
+    filesystem.memory_fs_clear()
+    uri = "memory://fixtures/a.rec"
+    w = recordio.MXRecordIO(uri, "w")
+    payloads = [b"alpha", b"bravo" * 100, b"x"]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(uri, "r")
+    got = []
+    while True:
+        buf = r.read()
+        if buf is None:
+            break
+        got.append(buf)
+    assert got == payloads
+    r.reset()  # reopen from the store, not a half-consumed buffer
+    assert r.read() == payloads[0]
+
+
+def test_image_record_iter_from_memory_uri(tmp_path):
+    """ImageRecordIter reads a .rec living in the memory:// store —
+    the native loader can't open non-file URIs, so this also proves the
+    scheme-aware Python fallback engages transparently."""
+    from mxnet_tpu import filesystem
+
+    filesystem.memory_fs_clear()
+    local = _make_rec(tmp_path, n=6, size=(32, 32))
+    uri = "memory://fixtures/imgs.rec"
+    with open(local, "rb") as f, filesystem.open_stream(uri, "wb") as out:
+        out.write(f.read())
+    it = mx.io.ImageRecordIter(path_imgrec=uri, data_shape=(3, 24, 24),
+                               batch_size=3)
+    labels = []
+    for b in it:
+        lab = b.label[0].asnumpy()
+        labels.extend(lab[:3 - b.pad].astype(int).tolist())
+    assert sorted(labels) == list(range(6))
+
+
+def test_unknown_scheme_raises():
+    from mxnet_tpu import filesystem
+    from mxnet_tpu.base import MXNetError
+
+    with pytest.raises(MXNetError, match="no stream opener"):
+        filesystem.open_stream("weird://bucket/x.rec")
+    # remote schemes route through fsspec; assert the clear error only
+    # where the s3 backend is genuinely absent
+    import importlib.util
+
+    if importlib.util.find_spec("s3fs") is None:
+        with pytest.raises(MXNetError, match="fsspec|backend"):
+            filesystem.open_stream("s3://bucket/x.rec")
