@@ -56,10 +56,12 @@ def run_config(batch, iters=None, repeats=None, remat=False):
     from mxnet_tpu import flops as flops_mod
     from mxnet_tpu import models
 
-    if remat:
+    _remat_set_here = remat and not os.environ.get("MXNET_BACKWARD_DO_MIRROR")
+    if _remat_set_here:
         os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
-    else:
-        os.environ.pop("MXNET_BACKWARD_DO_MIRROR", None)
+    # a user-set MXNET_BACKWARD_DO_MIRROR is honored (and recorded below),
+    # never silently stripped
+    remat = bool(os.environ.get("MXNET_BACKWARD_DO_MIRROR"))
     iters = iters or ITERS
     repeats = repeats or REPEATS
     sym = models.get_symbol("resnet-50", num_classes=1000)
@@ -182,6 +184,8 @@ def run_config(batch, iters=None, repeats=None, remat=False):
         rec["metric"] = rec["metric"].replace("_mfu_", "_imgs_per_sec_")
     if per_iter_ms is not None:
         rec["per_iter_ms_synced"] = per_iter_ms
+    if _remat_set_here:  # don't leak into later sweep configs
+        os.environ.pop("MXNET_BACKWARD_DO_MIRROR", None)
     return rec
 
 

@@ -383,6 +383,8 @@ def wait_for_file(path: str):
         finally:
             with _file_lock:
                 _file_waiting[apath] -= 1
+                if _file_waiting[apath] == 0:
+                    del _file_waiting[apath]  # no unbounded per-path table
         _retire_file_var(apath, var)
     with _file_lock:
         err = _file_errs.pop(apath, None)
@@ -395,6 +397,15 @@ def wait_for_all_files():
     call at end-of-training when using async_write."""
     with _file_lock:
         pending = list(_file_vars)
+    first_err = None
     for apath in pending:
-        wait_for_file(apath)  # raises the path's recorded error, if any
+        try:
+            wait_for_file(apath)
+        except BaseException as e:
+            # drain EVERY path before surfacing: a caller that catches the
+            # error must still find the other checkpoints fully written
+            if first_err is None:
+                first_err = e
+    if first_err is not None:
+        raise first_err
     _raise_pending_file_error()

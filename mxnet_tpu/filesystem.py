@@ -73,8 +73,11 @@ def exists(uri: str) -> bool:
     try:
         with open_stream(uri, "rb"):
             return True
-    except Exception:
+    except FileNotFoundError:
         return False
+    # auth/network/permission errors propagate: "absent" and "unreachable"
+    # must not be conflated (a transient blip would otherwise silently
+    # load an indexed reader with an empty index)
 
 
 # --- built-in openers -------------------------------------------------------

@@ -1,0 +1,164 @@
+"""Pallas weight-gradient kernel for small-window convolutions.
+
+The backward-weight conv is contraction-shaped — output (kh, kw, C, K) is
+tiny, the reduction runs over N*OH*OW — and XLA's emitter leaves
+throughput on the floor for part of the 3x3 family (measured per shape in
+tools/bench_conv_bwd.py; docs/perf.md ceiling analysis). This kernel
+reformulates dW as ONE tall matmul per grid cell:
+
+    for each image block: xcat[(l), (kh*kw*C)] = concat of the kh*kw
+    shifted views of the (pre-padded) input; dW += xcat^T @ dY_flat
+
+so the MXU sees an (ksz*ksz*C, L) x (L, K) contraction — M = 9C instead
+of nine M = C passes, which is what makes C=64..128 layers profitable
+(a lone (64, L) x (L, 64) matmul uses a quarter of the 128x128 array).
+
+Layout: NHWC inside the kernel (the MXU-native layout XLA itself
+relayouts to); the op-level fast path transposes at the boundary and
+lets XLA fuse the transposes into neighbors. f32 accumulation across
+grid steps (grid iterations are sequential on TPU), bf16 operands.
+
+Selection follows the measured table (must-not-lose, the
+cudnn-algoreg-inl.h contract): see use_wgrad_for().
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on TPU-enabled jaxlib builds
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+def _wgrad_kernel(x_ref, dy_ref, o_ref, *, ksz, stride, bn):
+    """One (k-block, image-block) grid cell.
+
+    x_ref: (BN, HP, WP, C) pre-padded input block (HP = OH*s + ksz - s)
+    dy_ref: (BN, OH, OW, BK)
+    o_ref: (ksz*ksz*C, BK) f32 accumulator (same block for every cell of
+           a given k-block; init on the first image block)
+    """
+    nb = pl.program_id(1)
+
+    @pl.when(nb == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    x = x_ref[:]                       # (BN, HP, WP, C)
+    dy = dy_ref[:]                     # (BN, OH, OW, BK)
+    _, oh, ow, bk = dy.shape
+    c = x.shape[-1]
+    dyf = dy.reshape(bn * oh * ow, bk)
+
+    def shift_view(kh, kw):
+        if stride == 1:
+            xs = x[:, kh:kh + oh, kw:kw + ow, :]
+        else:
+            # strided sampling via reshape-split (Mosaic-friendly: no
+            # strided slice): rows kh, kh+s, ... kh+(oh-1)*s
+            xs = x[:, kh:kh + oh * stride, kw:kw + ow * stride, :]
+            xs = xs.reshape(bn, oh, stride, ow, stride, c)[:, :, 0, :, 0, :]
+        return xs.reshape(bn * oh * ow, c)
+
+    if c < 128:
+        # small-C: a lone (C, L)x(L, K) pass wastes MXU rows; concatenate
+        # the shifts so M = ksz*ksz*C fills the array
+        xcat = jnp.concatenate(
+            [shift_view(kh, kw) for kh in range(ksz) for kw in range(ksz)],
+            axis=1)                              # (L, ksz*ksz*C)
+        o_ref[:] += jax.lax.dot_general(
+            xcat, dyf, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (ksz*ksz*C, BK)
+    else:
+        # large-C: per-shift dots already fill the MXU, and skipping the
+        # concatenation halves the kernel's VMEM footprint
+        for kh in range(ksz):
+            for kw in range(ksz):
+                part = jax.lax.dot_general(
+                    shift_view(kh, kw), dyf, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)      # (C, BK)
+                idx = kh * ksz + kw
+                o_ref[pl.ds(idx * c, c), :] += part
+
+
+def conv_wgrad(x, dy, ksz, stride=1, pad=None, block_n=None, block_k=None,
+               interpret=False):
+    """dW for conv(x, W) with an (ksz, ksz) window, ``stride``, symmetric
+    ``pad`` (default SAME-style (ksz-1)//2).
+
+    x: (N, H, W, C) — NHWC; dy: (N, OH, OW, K). Returns (ksz, ksz, C, K)
+    f32 (HWIO), the caller transposes to its layout.
+    """
+    n, h, w, c = x.shape
+    _, oh, ow, k = dy.shape
+    if pad is None:
+        pad = (ksz - 1) // 2
+    # pre-pad in XLA (one fused pad); kernel sees the full window field
+    # (+ksz-1 so every shift can slice oh*stride rows for the reshape-
+    # based strided sampling, clamp-free)
+    hp = oh * stride + ksz - 1
+    wp = ow * stride + ksz - 1
+    xp = jnp.pad(x, ((0, 0), (pad, hp - h - pad), (pad, wp - w - pad),
+                     (0, 0)))
+    if block_n is None:
+        # target ~1.5k-long contractions per cell; Mosaic's scoped-VMEM
+        # stack holds the shift-view copies, so the budget is tighter
+        # than the raw block sizes suggest (empirical: bn*oh*ow ≤ ~1600
+        # compiles across the ResNet family)
+        block_n = max(1, min(n, 1600 // max(1, oh * ow)))
+        while n % block_n:
+            block_n -= 1
+    if block_k is None:
+        block_k = k if (ksz * ksz * c * k * 4 <= 6 * 2 ** 20) else \
+            max(128, k // 2)
+        while k % block_k:
+            block_k //= 2
+    kernel = functools.partial(_wgrad_kernel, ksz=ksz, stride=stride,
+                               bn=block_n)
+    kwargs = {}
+    if pltpu is not None and not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))
+    out = pl.pallas_call(
+        kernel,
+        grid=(k // block_k, n // block_n),
+        in_specs=[
+            pl.BlockSpec((block_n, hp, wp, c), lambda kb, nb: (nb, 0, 0, 0)),
+            pl.BlockSpec((block_n, oh, ow, block_k),
+                         lambda kb, nb: (nb, 0, 0, kb)),
+        ],
+        out_specs=pl.BlockSpec((ksz * ksz * c, block_k),
+                               lambda kb, nb: (0, kb)),
+        out_shape=jax.ShapeDtypeStruct((ksz * ksz * c, k), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n * oh * ow * c * k * ksz * ksz,
+            bytes_accessed=(xp.size * (k // block_k) + dy.size) * 2,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+        **kwargs,
+    )(xp.astype(jnp.bfloat16), dy.astype(jnp.bfloat16))
+    return out.reshape(ksz, ksz, c, k)
+
+
+def use_wgrad_for(c, k, oh, ksz, stride):
+    """Measured-selection predicate (tools/bench_conv_bwd.py table in
+    docs/perf.md): the kernel is wired only where it beats XLA's
+    weight-grad emitter on this chip family."""
+    if ksz != 3:
+        return False
+    return (c, k, stride) in _WGRAD_WINS
+
+
+# (C, K, stride) combos where conv_wgrad measured faster than XLA.
+# Round-3 result on v5e: EMPTY — XLA's weight-grad emitter won at every
+# ResNet 3x3 shape (0.52-0.63x, table in docs/perf.md): the kernel pays
+# nine shifted VMEM copies per input block where the emitter windows
+# implicitly. Kept per the must-not-lose contract for chip generations
+# where the balance differs; re-run tools/bench_conv_bwd.py to repopulate.
+_WGRAD_WINS: set = set()

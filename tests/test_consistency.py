@@ -329,8 +329,8 @@ def test_pallas_kernel_coverage_is_complete():
     from mxnet_tpu.ops import pallas
 
     tested = {"flash_attention", "lstm_step", "sgd_mom_update",
-              "adam_update"}
-    helpers = {"on_tpu", "use_for"}  # selection predicates, not kernels
+              "adam_update", "conv_wgrad"}
+    helpers = {"on_tpu", "use_for", "use_wgrad_for"}  # selection predicates
     public = set()
     # enumerate the PACKAGE, not a hardcoded list, so a kernel added in a
     # new ops/pallas module cannot escape the gate
@@ -345,3 +345,40 @@ def test_pallas_kernel_coverage_is_complete():
     assert not missing, (
         "Pallas kernels without an interpret-vs-plain consistency test: %s"
         % sorted(missing))
+
+
+def test_pallas_conv_wgrad_matches_plain():
+    """conv_bwd.conv_wgrad (interpret) vs the XLA vjp weight-grad across
+    kernel/stride/odd-size variants."""
+    from mxnet_tpu.ops.pallas.conv_bwd import conv_wgrad
+
+    def ref(x, dy, ksz, stride, pad):
+        dn = jax.lax.conv_dimension_numbers(
+            x.shape, (ksz, ksz, x.shape[-1], dy.shape[-1]),
+            ("NHWC", "HWIO", "NHWC"))
+
+        def f(w):
+            return jax.lax.conv_general_dilated(
+                x, w, (stride, stride), [(pad, pad), (pad, pad)],
+                dimension_numbers=dn)
+
+        w0 = jnp.zeros((ksz, ksz, x.shape[-1], dy.shape[-1]), x.dtype)
+        return jax.vjp(f, w0)[1](dy)[0]
+
+    rng = np.random.RandomState(0)
+    for (n, h, c, k, ksz, stride) in [(2, 8, 8, 16, 3, 1),
+                                      (2, 9, 8, 16, 3, 1),
+                                      (2, 8, 8, 16, 3, 2),
+                                      (1, 5, 4, 8, 1, 1),
+                                      (4, 7, 16, 32, 3, 1)]:
+        pad = (ksz - 1) // 2
+        oh = (h + 2 * pad - ksz) // stride + 1
+        x = jnp.asarray(rng.randn(n, h, h, c).astype(np.float32))
+        dy = jnp.asarray(rng.randn(n, oh, oh, k).astype(np.float32))
+        got = np.asarray(conv_wgrad(x, dy, ksz, stride, interpret=True))
+        want = np.asarray(ref(x, dy, ksz, stride, pad), np.float32)
+        # kernel computes in bf16 operands / f32 accumulation
+        np.testing.assert_allclose(
+            got, want, rtol=2e-2,
+            atol=2e-2 * max(1.0, np.abs(want).max()),
+            err_msg=str((n, h, c, k, ksz, stride)))

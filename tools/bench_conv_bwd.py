@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Per-shape conv forward/backward microbenchmark (XLA emitters vs the
+Pallas fast-path candidates).
+
+Times every distinct ResNet-50 conv shape (at the headline batch) three
+ways — forward, data-grad, weight-grad — through the same lax.conv
+lowering the executor uses, bf16, NCHW (XLA:TPU relayouts internally).
+This is the measurement underneath docs/perf.md's backward-conv ceiling
+analysis and the selection table for the Pallas weight-grad kernel
+(ops/pallas/conv_bwd.py): the fast path is only wired where this table
+says XLA leaves throughput on the floor.
+
+    python tools/bench_conv_bwd.py [--batch 128] [--json]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# ResNet-50 conv inventory at 224^2: (name, C, H/W, K, kernel, stride, count)
+# counts = occurrences per fwd pass (conv2..conv5 blocks; 1x1 projections
+# included since their backward shares the same emitter family).
+SHAPES = [
+    ("stem7x7s2", 3, 224, 64, 7, 2, 1),
+    ("c2_3x3", 64, 56, 64, 3, 1, 3),
+    ("c2_1x1a", 64, 56, 64, 1, 1, 3),
+    ("c2_1x1b", 64, 56, 256, 1, 1, 3),
+    ("c2_1x1c", 256, 56, 64, 1, 1, 2),
+    ("c3_3x3s2", 128, 56, 128, 3, 2, 1),
+    ("c3_3x3", 128, 28, 128, 3, 1, 3),
+    ("c3_1x1a", 256, 56, 128, 1, 1, 1),
+    ("c3_1x1b", 128, 28, 512, 1, 1, 4),
+    ("c3_1x1c", 512, 28, 128, 1, 1, 3),
+    ("c4_3x3s2", 256, 28, 256, 3, 2, 1),
+    ("c4_3x3", 256, 14, 256, 3, 1, 5),
+    ("c4_1x1a", 512, 28, 256, 1, 1, 1),
+    ("c4_1x1b", 256, 14, 1024, 1, 1, 6),
+    ("c4_1x1c", 1024, 14, 256, 1, 1, 5),
+    ("c5_3x3s2", 512, 14, 512, 3, 2, 1),
+    ("c5_3x3", 512, 7, 512, 3, 1, 2),
+    ("c5_1x1a", 1024, 14, 512, 1, 1, 1),
+    ("c5_1x1b", 512, 7, 2048, 1, 1, 3),
+    ("c5_1x1c", 2048, 7, 512, 1, 1, 2),
+]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--reps", type=int, default=300)
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--only", help="substring filter on shape name")
+    p.add_argument("--no-pallas", action="store_true")
+    args = p.parse_args()
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    N = args.batch
+    rows = []
+    for (name, C, HW, K, ksz, stride, count) in SHAPES:
+        if args.only and args.only not in name:
+            continue
+        pad = (ksz - 1) // 2
+        OH = (HW + 2 * pad - ksz) // stride + 1
+        x = jnp.asarray(np.random.RandomState(0)
+                        .randn(N, C, HW, HW).astype(np.float32),
+                        dtype=jnp.bfloat16)
+        w = jnp.asarray(np.random.RandomState(1)
+                        .randn(K, C, ksz, ksz).astype(np.float32) * 0.1,
+                        dtype=jnp.bfloat16)
+        dy = jnp.asarray(np.random.RandomState(2)
+                         .randn(N, K, OH, OH).astype(np.float32),
+                         dtype=jnp.bfloat16)
+        dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                            ("NCHW", "OIHW", "NCHW"))
+
+        def conv(x, w):
+            return jax.lax.conv_general_dilated(
+                x, w, (stride, stride), [(pad, pad), (pad, pad)],
+                dimension_numbers=dn,
+                preferred_element_type=jnp.bfloat16)
+
+        # Sub-ms kernels: host dispatch through the dev tunnel costs ~4 ms
+        # per execution, so each measurement is a fori_loop of R chained
+        # iterations INSIDE one jitted program — the op under test feeds a
+        # scalar back into a (numerically inert) perturbation of x, which
+        # defeats CSE/hoisting. The perturbation's own cost is measured by
+        # an empty-chain baseline and subtracted.
+        R = args.reps
+
+        # Each op must be chained through an argument its VALUE depends
+        # on, or XLA hoists it out of the loop (dgrad is linear in x: its
+        # result depends only on (w, dy), so the chain must run through
+        # dy there).
+        def chained(op, carried):
+            def run(x, w, dy):
+                init = {"x": x, "dy": dy}[carried]
+                other = {"x": (w, dy), "dy": (x, w)}[carried]
+
+                def body(i, carry):
+                    buf, s = carry
+                    if carried == "x":
+                        out = op(buf, other[0], other[1])
+                    else:
+                        out = op(other[0], other[1], buf)
+                    # consume ALL of out NON-algebraically: sum(out) of a
+                    # linear op strength-reduces to a trivial form (and a
+                    # single-element read lets XLA slice the conv away);
+                    # sum(out^2) forces full materialization
+                    s2 = jnp.sum(jnp.square(out.astype(jnp.float32)))
+                    # single-element in-place add on the loop carry: a
+                    # real data dependence (defeats hoisting) at ~zero
+                    # cost — s*1e-38 rounds away in bf16, values intact
+                    buf2 = buf.at[(0,) * buf.ndim].add(
+                        (s2 * 1e-38).astype(buf.dtype))
+                    return (buf2, s2)
+                _, s = jax.lax.fori_loop(0, R, body, (init, jnp.float32(0)))
+                return s
+            return jax.jit(run)
+
+        def pallas_wgrad(x_, w_, dy_):
+            # same contraction through the Pallas kernel (NHWC inside;
+            # boundary transposes included in its cost, as the real fast
+            # path would pay them)
+            from mxnet_tpu.ops.pallas.conv_bwd import conv_wgrad
+
+            xh = jnp.transpose(x_, (0, 2, 3, 1))
+            dyh = jnp.transpose(dy_, (0, 2, 3, 1))
+            dw = conv_wgrad(xh, dyh, ksz, stride, pad)  # (kh,kw,C,K) f32
+            return jnp.transpose(dw, (3, 2, 0, 1)).astype(w_.dtype)
+
+        ops = {
+            "fwd": (lambda x_, w_, dy_: conv(x_, w_), "x"),
+            "dgrad": (lambda x_, w_, dy_: jax.vjp(
+                lambda a: conv(a, w_), x_)[1](dy_)[0], "dy"),
+            "wgrad": (lambda x_, w_, dy_: jax.vjp(
+                lambda a: conv(x_, a), w_)[1](dy_)[0], "x"),
+            "plwg": (pallas_wgrad, "x"),
+        }
+
+        def timeit(f):
+            np.asarray(f(x, w, dy))
+            best = None
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(f(x, w, dy))
+                t = (time.perf_counter() - t0) / R
+                best = t if best is None else min(best, t)
+            return best
+
+        # measured time includes the sum(out^2) consumer; subtract its
+        # analytic bandwidth cost (one read of out at ~700 GB/s measured
+        # effective) so absolute TF/s stay honest — the XLA-vs-Pallas
+        # COMPARISON is unaffected either way (same harness both sides)
+        def est_sum(n_elems):
+            return n_elems * 2 / 700e9
+
+        t_f = max(1e-9, timeit(chained(*ops["fwd"])) - est_sum(dy.size))
+        t_d = max(1e-9, timeit(chained(*ops["dgrad"])) - est_sum(x.size))
+        t_w = max(1e-9, timeit(chained(*ops["wgrad"])) - est_sum(w.size))
+        t_p = None
+        if ksz == 3 and not args.no_pallas:
+            try:
+                t_p = max(1e-9,
+                          timeit(chained(*ops["plwg"])) - est_sum(w.size))
+            except Exception as e:
+                print("  pallas wgrad failed for %s: %s" % (name, e))
+        flops = 2.0 * N * OH * OH * C * K * ksz * ksz
+        row = dict(name=name, C=C, HW=HW, K=K, k=ksz, s=stride, count=count,
+                   fwd_ms=round(t_f * 1e3, 3), fwd_tf=round(flops / t_f / 1e12, 1),
+                   dgrad_ms=round(t_d * 1e3, 3), dgrad_tf=round(flops / t_d / 1e12, 1),
+                   wgrad_ms=round(t_w * 1e3, 3), wgrad_tf=round(flops / t_w / 1e12, 1))
+        if t_p is not None:
+            row["plwg_ms"] = round(t_p * 1e3, 3)
+            row["plwg_tf"] = round(flops / t_p / 1e12, 1)
+        rows.append(row)
+        if args.json:
+            print(json.dumps(row), flush=True)
+        else:
+            extra = ("" if t_p is None else
+                     " | PALLAS wgrad %6.2fms %5.1fTF (%.2fx)"
+                     % (row["plwg_ms"], row["plwg_tf"], t_w / t_p))
+            print("%-10s C=%-4d HW=%-3d K=%-4d k=%d s=%d x%d | "
+                  "fwd %6.2fms %5.1fTF | dgrad %6.2fms %5.1fTF | "
+                  "wgrad %6.2fms %5.1fTF%s"
+                  % (name, C, HW, K, ksz, stride, count,
+                     row["fwd_ms"], row["fwd_tf"], row["dgrad_ms"],
+                     row["dgrad_tf"], row["wgrad_ms"], row["wgrad_tf"],
+                     extra), flush=True)
+
+    tot = {"fwd": 0.0, "dgrad": 0.0, "wgrad": 0.0}
+    fl = 0.0
+    for r in rows:
+        tot["fwd"] += r["fwd_ms"] * r["count"]
+        tot["dgrad"] += r["dgrad_ms"] * r["count"]
+        tot["wgrad"] += r["wgrad_ms"] * r["count"]
+        fl += 2.0 * N * (r["HW"] // r["s"]) ** 2 * r["C"] * r["K"] * r["k"] ** 2 \
+            * r["count"]
+    print("totals (weighted by count): fwd %.1f ms, dgrad %.1f ms, "
+          "wgrad %.1f ms; conv FLOPs/step %.2f TF"
+          % (tot["fwd"], tot["dgrad"], tot["wgrad"], fl / 1e12))
+
+
+if __name__ == "__main__":
+    main()
