@@ -35,8 +35,11 @@ MIN_SEQ = 1024
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale, block_k):
-    """One (batch*head, q-block) grid cell."""
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, causal, scale,
+               block_k):
+    """One (batch*head, q-block) grid cell. Writes O, and the per-row
+    logsumexp when a ref for it is supplied (training forward — the
+    blocked backward needs it; inference skips the extra HBM write)."""
     q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
     bq = q.shape[0]
     tk = k_ref.shape[1]
@@ -75,11 +78,13 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale, block_k):
         hi = jax.lax.min(num_k_blocks, pl.cdiv((qi + 1) * bq, block_k))
     else:
         hi = num_k_blocks
-    acc, _, l = jax.lax.fori_loop(0, hi, body, init)
+    acc, m, l = jax.lax.fori_loop(0, hi, body, init)
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    if maybe_lse_ref:
+        maybe_lse_ref[0][0, 0] = m + jnp.log(l)
 
 
-def _fa_forward(q, k, v, causal, scale, interpret):
+def _fa_forward(q, k, v, causal, scale, interpret, with_lse=False):
     bh, tq, d = q.shape
     tk = k.shape[1]
     block_q = min(BLOCK_Q, tq)
@@ -90,7 +95,15 @@ def _fa_forward(q, k, v, causal, scale, interpret):
     if pltpu is not None and not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"))
-    return pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((bh, tq, d), q.dtype)]
+    if with_lse:
+        # (bh, 1, tq): TPU block rules need the last two dims (1, BQ)
+        # where 1 equals the array dim and BQ is lane-aligned
+        out_specs.append(pl.BlockSpec((1, 1, block_q),
+                                      lambda b, i: (b, 0, i)))
+        out_shape.append(jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32))
+    res = pl.pallas_call(
         kernel,
         grid=(bh, pl.cdiv(tq, block_q)),
         in_specs=[
@@ -98,8 +111,8 @@ def _fa_forward(q, k, v, causal, scale, interpret):
             pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         cost_estimate=pl.CostEstimate(
             flops=4 * bh * tq * tk * d,
             bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
@@ -108,6 +121,158 @@ def _fa_forward(q, k, v, causal, scale, interpret):
         interpret=interpret,
         **kwargs,
     )(q, k, v)
+    return (res[0], res[1]) if with_lse else res[0]
+
+
+# --- blocked backward (FlashAttention-2 style: no S^2 materialization) ------
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
+                      dq_ref, *, causal, scale, block_k):
+    """dQ for one (batch*head, q-block): stream k/v blocks, rebuild p from
+    the saved logsumexp, dq += (p * (dO v^T - D)) @ k * scale."""
+    q = q_ref[0].astype(jnp.float32)               # (BQ, D)
+    do = do_ref[0].astype(jnp.float32)             # (BQ, D)
+    lse = lse_ref[0, 0]                            # (BQ,)
+    dvec = dvec_ref[0, 0]                          # (BQ,)
+    bq = q.shape[0]
+    tk = k_ref.shape[1]
+    qi = pl.program_id(1)
+    num_k_blocks = pl.cdiv(tk, block_k)
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])              # (BQ, BK), rows sum<=1
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dvec[:, None])
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    hi = (jax.lax.min(num_k_blocks, pl.cdiv((qi + 1) * bq, block_k))
+          if causal else num_k_blocks)
+    dq = jax.lax.fori_loop(0, hi, body,
+                           jnp.zeros((bq, q.shape[1]), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
+                       dk_ref, dv_ref, *, causal, scale, block_q):
+    """dK/dV for one (batch*head, k-block): stream q/dO blocks."""
+    k = k_ref[0].astype(jnp.float32)               # (BK, D)
+    v = v_ref[0].astype(jnp.float32)               # (BK, D)
+    bk = k.shape[0]
+    tq = q_ref.shape[1]
+    ki = pl.program_id(1)
+    num_q_blocks = pl.cdiv(tq, block_q)
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        dvec = dvec_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])              # (BQ, BK)
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dvec[:, None])
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    # causal: q blocks strictly before this k block's start contribute
+    # nothing (every entry masked)
+    lo = (ki * bk) // block_q if causal else 0
+    d = k.shape[1]
+    dk, dv = jax.lax.fori_loop(
+        lo, num_q_blocks, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _fa_backward(q, k, v, o, lse, do, causal, scale, interpret):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    block_q = min(BLOCK_Q, tq)
+    block_k = min(BLOCK_K, tk)
+    # D_i = rowsum(dO * O): one cheap fused XLA pass
+    dvec = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                   axis=-1)[:, None, :]            # (bh, 1, tq)
+    kwargs = {}
+    if pltpu is not None and not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, causal=causal, scale=scale,
+                          block_k=block_k),
+        grid=(bh, pl.cdiv(tq, block_q)),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=6 * bh * tq * tk * d,
+            bytes_accessed=(q.size + k.size + v.size + do.size)
+            * q.dtype.itemsize,
+            transcendentals=bh * tq * tk),
+        interpret=interpret,
+        **kwargs,
+    )(q, k, v, do, lse, dvec)
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_kernel, causal=causal, scale=scale,
+                          block_q=block_q),
+        grid=(bh, pl.cdiv(tk, block_k)),
+        in_specs=[
+            pl.BlockSpec((1, tq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, tq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, tq), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, tq), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+        ],
+        cost_estimate=pl.CostEstimate(
+            # 4 matmuls per (q,k) tile pair: s, p^T@dO, dO@v^T, ds^T@q
+            flops=8 * bh * tq * tk * d,
+            bytes_accessed=(q.size + k.size + v.size + do.size)
+            * q.dtype.itemsize,
+            transcendentals=bh * tq * tk),
+        interpret=interpret,
+        **kwargs,
+    )(q, k, v, do, lse, dvec)
+    return dq, dk, dv
 
 
 def _aligned(t, block):
@@ -120,23 +285,19 @@ def _flash(q3, k3, v3, causal, scale, interpret):
 
 
 def _flash_fwd(q3, k3, v3, causal, scale, interpret):
-    return _fa_forward(q3, k3, v3, causal, scale, interpret), (q3, k3, v3)
+    out, lse = _fa_forward(q3, k3, v3, causal, scale, interpret,
+                           with_lse=True)
+    return out, (q3, k3, v3, out, lse)
 
 
 def _flash_bwd(causal, scale, interpret, res, g):
-    # Recompute-based backward through the reference math (the kernel and
-    # the reference compute identical values). A blocked Pallas backward is
-    # a planned fast path; XLA still fuses this into a handful of matmuls.
-    from .. import attention as _att
-
-    q3, k3, v3 = res
-
-    def ref(q, k, v):
-        return _att.dot_product_attention(q[:, None], k[:, None], v[:, None],
-                                          causal=causal, scale=scale)[:, 0]
-
-    _, vjp = jax.vjp(ref, q3, k3, v3)
-    return vjp(g)
+    # Blocked FlashAttention-2 backward: rebuilds p per tile from the
+    # saved logsumexp — never materializes the (Tq, Tk) score matrix, so
+    # long-sequence TRAINING scales like the forward (docs/perf.md
+    # attention section; previously this was recompute-through-the-
+    # reference-math and the S^2 backward dominated at seq >= 4096).
+    q3, k3, v3, o3, lse = res
+    return _fa_backward(q3, k3, v3, o3, lse, g, causal, scale, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -149,21 +310,24 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
 
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    hard_ok = (_aligned(q.shape[-2], BLOCK_Q)
-               and _aligned(k.shape[-2], BLOCK_K)
-               and q.shape[-1] % 128 == 0)
+    # CORRECTNESS requirement (any mode): sequence lengths divide into
+    # whole blocks — a ragged final block would read padding into the
+    # softmax. PERF selection (auto mode only): lane-aligned head_dim and
+    # the measured MIN_SEQ win threshold.
+    align_ok = (_aligned(q.shape[-2], BLOCK_Q)
+                and _aligned(k.shape[-2], BLOCK_K))
     if interpret is None:
-        # auto mode: the kernel is SELECTED only on TPU with aligned
-        # shapes at sequence lengths where it measurably wins
-        if not (on_tpu() and hard_ok and q.shape[-2] >= MIN_SEQ):
+        if not (on_tpu() and align_ok and q.shape[-1] % 128 == 0
+                and q.shape[-2] >= MIN_SEQ):
             return _att.dot_product_attention(q, k, v, causal=causal,
                                               scale=scale)
         interpret = False
-    elif not interpret and not hard_ok:
-        # explicit interpret=False forces the compiled kernel PAST the
-        # MIN_SEQ perf gate (benches), but shapes Mosaic cannot tile
-        # still fall back rather than fail at lowering; interpret=True
-        # (tests) runs the interpreter, which handles any shape
+    elif not (align_ok and (interpret or q.shape[-1] % 128 == 0)):
+        # explicit interpret=True/False forces the kernel past the
+        # MIN_SEQ perf gate (tests/benches), but never past the block
+        # contract — and the compiled path also keeps the lane-aligned
+        # head_dim requirement (Mosaic lowering), which the interpreter
+        # doesn't need
         return _att.dot_product_attention(q, k, v, causal=causal,
                                           scale=scale)
 
