@@ -209,14 +209,20 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _fa_backward(q, k, v, o, lse, do, causal, scale, interpret):
+def _fa_backward(q, k, v, o, lse, do, causal, scale, interpret,
+                 g_lse=None):
     bh, tq, d = q.shape
     tk = k.shape[1]
     block_q = min(BLOCK_Q, tq)
     block_k = min(BLOCK_K, tk)
-    # D_i = rowsum(dO * O): one cheap fused XLA pass
+    # D_i = rowsum(dO * O): one cheap fused XLA pass. A cotangent on the
+    # logsumexp output folds in here: d(lse)/ds = p, so ds gains
+    # +g_lse*p, i.e. D := D - g_lse (ring attention's merge
+    # differentiates through lse).
     dvec = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                    axis=-1)[:, None, :]            # (bh, 1, tq)
+    if g_lse is not None:
+        dvec = dvec - g_lse.astype(jnp.float32)
     kwargs = {}
     if pltpu is not None and not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(
@@ -279,6 +285,16 @@ def _aligned(t, block):
     return t % min(block, t) == 0
 
 
+def kernel_qualifies(tq, tk, d, compiled=True):
+    """The kernel's CORRECTNESS contract: sequence lengths divide into
+    whole blocks (a ragged final block would read padding into the
+    softmax); the compiled path additionally needs a lane-aligned
+    head_dim. Shared by flash_attention() and ring_attention's per-shard
+    selection so the two paths cannot drift."""
+    return (_aligned(tq, BLOCK_Q) and _aligned(tk, BLOCK_K)
+            and (not compiled or d % 128 == 0))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash(q3, k3, v3, causal, scale, interpret):
     return _fa_forward(q3, k3, v3, causal, scale, interpret)
@@ -303,6 +319,30 @@ def _flash_bwd(causal, scale, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_with_lse(q3, k3, v3, causal, scale, interpret):
+    """(out, lse (bh,1,tq)) variant — ring attention's per-shard compute
+    merges across shards using the logsumexp, so lse is a REAL output
+    with its own cotangent here (folded into the D-vector in backward)."""
+    return _fa_forward(q3, k3, v3, causal, scale, interpret, with_lse=True)
+
+
+def _flash_with_lse_fwd(q3, k3, v3, causal, scale, interpret):
+    out, lse = _fa_forward(q3, k3, v3, causal, scale, interpret,
+                           with_lse=True)
+    return (out, lse), (q3, k3, v3, out, lse)
+
+
+def _flash_with_lse_bwd(causal, scale, interpret, res, g):
+    q3, k3, v3, o3, lse = res
+    g_out, g_lse = g
+    return _fa_backward(q3, k3, v3, o3, lse, g_out, causal, scale,
+                        interpret, g_lse=g_lse)
+
+
+_flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
+
+
 def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
     """Attention over (B, H, T, D). Pallas on TPU, XLA reference otherwise."""
     from .. import attention as _att
@@ -310,24 +350,20 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
 
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    # CORRECTNESS requirement (any mode): sequence lengths divide into
-    # whole blocks — a ragged final block would read padding into the
-    # softmax. PERF selection (auto mode only): lane-aligned head_dim and
-    # the measured MIN_SEQ win threshold.
-    align_ok = (_aligned(q.shape[-2], BLOCK_Q)
-                and _aligned(k.shape[-2], BLOCK_K))
+    # kernel_qualifies = the correctness contract; MIN_SEQ = the measured
+    # perf threshold (auto mode only)
     if interpret is None:
-        if not (on_tpu() and align_ok and q.shape[-1] % 128 == 0
+        if not (on_tpu()
+                and kernel_qualifies(q.shape[-2], k.shape[-2], q.shape[-1])
                 and q.shape[-2] >= MIN_SEQ):
             return _att.dot_product_attention(q, k, v, causal=causal,
                                               scale=scale)
         interpret = False
-    elif not (align_ok and (interpret or q.shape[-1] % 128 == 0)):
+    elif not kernel_qualifies(q.shape[-2], k.shape[-2], q.shape[-1],
+                              compiled=not interpret):
         # explicit interpret=True/False forces the kernel past the
         # MIN_SEQ perf gate (tests/benches), but never past the block
-        # contract — and the compiled path also keeps the lane-aligned
-        # head_dim requirement (Mosaic lowering), which the interpreter
-        # doesn't need
+        # contract
         return _att.dot_product_attention(q, k, v, causal=causal,
                                           scale=scale)
 

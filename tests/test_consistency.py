@@ -330,7 +330,8 @@ def test_pallas_kernel_coverage_is_complete():
 
     tested = {"flash_attention", "lstm_step", "sgd_mom_update",
               "adam_update", "conv_wgrad"}
-    helpers = {"on_tpu", "use_for", "use_wgrad_for"}  # selection predicates
+    helpers = {"on_tpu", "use_for", "use_wgrad_for",
+               "kernel_qualifies"}  # selection predicates, not kernels
     public = set()
     # enumerate the PACKAGE, not a hardcoded list, so a kernel added in a
     # new ops/pallas module cannot escape the gate
@@ -382,3 +383,27 @@ def test_pallas_conv_wgrad_matches_plain():
             got, want, rtol=2e-2,
             atol=2e-2 * max(1.0, np.abs(want).max()),
             err_msg=str((n, h, c, k, ksz, stride)))
+
+
+def test_pallas_flash_backward_multiblock_causal():
+    """S=512 = 2 query x 2 key blocks: exercises the blocked backward's
+    causal loop bounds (dq's `hi`, dkv's `lo`) which single-block shapes
+    never touch; all THREE grads checked vs the XLA vjp in exact f32."""
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention
+    from mxnet_tpu.ops.attention import dot_product_attention
+
+    rng = np.random.RandomState(5)
+    B, H, S, D = 1, 1, 512, 8
+    q = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+    for causal in (True, False):
+        gf = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=causal, interpret=True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gp = jax.grad(lambda q, k, v: jnp.sum(dot_product_attention(
+            q, k, v, causal=causal) ** 2), argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gf, gp):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
+                err_msg="%s causal=%s" % (name, causal))
