@@ -94,6 +94,33 @@ def micro(args):
               % (B, H, S, D, args.causal, t_plain * 1e3,
                  flops / t_plain / 1e12, t_flash * 1e3,
                  flops / t_flash / 1e12, t_plain / t_flash, maxdiff))
+
+        # fwd+bwd: grads wrt ALL of q,k,v (argnums=0 alone would let DCE
+        # drop the dkv kernel entirely), reduced to a scalar INSIDE the
+        # jit (a fresh (B,H,S,D) output per rep pays the tunnel's
+        # fresh-buffer cost and swamps the kernel time — same rule as the
+        # forward closures above)
+        def fb(f):
+            def scalar(q, k, v):
+                g = jax.grad(lambda q, k, v: jnp.sum(jnp.square(
+                    f(q, k, v).astype(jnp.float32))),
+                    argnums=(0, 1, 2))(q, k, v)
+                return sum(jnp.sum(x.astype(jnp.float32)) for x in g)
+            return jax.jit(scalar)
+
+        tb_plain = timeit(fb(lambda q, k, v: att.dot_product_attention(
+            q, k, v, causal=args.causal)))
+        tb_flash = timeit(fb(lambda q, k, v: fa.flash_attention(
+            q, k, v, causal=args.causal, interpret=interp)))
+        # USEFUL work (same for both paths): bwd = 2.5x fwd (5 necessary
+        # matmuls vs 2), total 3.5x — the flash kernels' score recompute
+        # is deliberately NOT credited (standard flash accounting)
+        fb_flops = flops * 3.5
+        print("  fwd+bwd: plain %.3f ms (%.0f TF/s)  flash %.3f ms "
+              "(%.0f TF/s)  speedup %.2fx"
+              % (tb_plain * 1e3, fb_flops / tb_plain / 1e12,
+                 tb_flash * 1e3, fb_flops / tb_flash / 1e12,
+                 tb_plain / tb_flash))
     return rows
 
 

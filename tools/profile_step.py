@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Profile the fused ResNet-50 training step on the real chip and print
+the device-time breakdown by HLO category (+ top loop fusions with
+achieved bandwidth).
+
+This is the harness behind docs/perf.md's ceiling analysis: capture a
+jax.profiler trace of N steps, then parse the xplane directly
+(tensorflow.tsl xplane proto — the tensorboard plugin converter in this
+image has a proto-version mismatch) and aggregate the "XLA Ops" lane by
+the hlo_category stat, with model_flops/bytes_accessed for achieved
+TF/s / GB/s.
+
+    PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python \
+        python tools/profile_step.py [--batch 128] [--steps 5]
+"""
+import argparse
+import collections
+import glob
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def capture(batch, steps, logdir):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+
+    sym = models.get_symbol("resnet-50", num_classes=1000)
+    grad_req = {n: ("null" if n in ("data", "softmax_label") else "write")
+                for n in sym.list_arguments()}
+    exe = sym.simple_bind(mx.Context("tpu", 0), grad_req=grad_req,
+                          compute_dtype="bfloat16",
+                          data=(batch, 3, 224, 224), softmax_label=(batch,))
+    init = mx.initializer.Xavier(factor_type="in", magnitude=2.0)
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            init(mx.initializer.InitDesc(name), arr)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.uniform(-1, 1, (batch, 3, 224, 224))
+                    .astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 1000, (batch,)).astype(np.float32))
+    lr, momentum, wd = 0.05, 0.9, 1e-4
+    pn = [n for n in exe.arg_dict if n not in ("data", "softmax_label")]
+
+    def sgd_all(params, grads, moms):
+        np_, nm = {}, {}
+        for n in params:
+            g = grads[n] + wd * params[n]
+            m = momentum * moms[n] - lr * g
+            np_[n] = params[n] + m
+            nm[n] = m
+        return np_, nm
+
+    step = exe.make_train_step(sgd_all)
+    params = {n: jnp.array(exe.arg_dict[n]._data, copy=True) for n in pn}
+    moms = {n: jnp.zeros_like(v) for n, v in params.items()}
+    feed = {"data": x, "softmax_label": y}
+    for _ in range(3):
+        outs, params, moms = step(params, moms, feed)
+    np.asarray(jnp.reshape(outs[0], (-1,))[0])
+    shutil.rmtree(logdir, ignore_errors=True)
+    with jax.profiler.trace(logdir):
+        for _ in range(steps):
+            outs, params, moms = step(params, moms, feed)
+        np.asarray(jnp.reshape(outs[0], (-1,))[0])
+
+
+def report(logdir, steps):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xs = sorted(glob.glob(logdir + "/**/*.xplane.pb", recursive=True))
+    if not xs:
+        raise SystemExit("no xplane.pb found under %r — did the capture "
+                         "run on a real TPU?" % logdir)
+    space = xplane_pb2.XSpace()
+    with open(xs[0], "rb") as f:
+        space.ParseFromString(f.read())
+    for plane in space.planes:
+        if plane.name != "/device:TPU:0":
+            continue
+        stat_names = {k: v.name for k, v in plane.stat_metadata.items()}
+        md = {}
+        for k, v in plane.event_metadata.items():
+            d = {"name": v.name}
+            for st in v.stats:
+                sn = stat_names.get(st.metadata_id, "")
+                if sn == "hlo_category":
+                    d["cat"] = st.str_value
+                elif sn == "model_flops":
+                    d["flops"] = st.int64_value
+                elif sn == "bytes_accessed":
+                    d["bytes"] = st.int64_value
+            md[k] = d
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            cat = collections.Counter()
+            fl = collections.Counter()
+            loops = collections.Counter()
+            lbytes = {}
+            total = 0.0
+            for ev in line.events:
+                m = md[ev.metadata_id]
+                c = m.get("cat", "uncategorized")
+                dur = ev.duration_ps / 1e9
+                cat[c] += dur
+                fl[c] += m.get("flops", 0)
+                total += dur
+                if c == "loop fusion":
+                    # key by FULL name: truncated keys can collide and
+                    # merge distinct fusions' durations
+                    loops[m["name"]] += dur
+                    lbytes[m["name"]] = m.get("bytes", 0)
+            print("device total %.2f ms/step" % (total / steps))
+            for k, v in cat.most_common(12):
+                tf_s = (fl[k] / steps) / (v / steps * 1e-3) / 1e12 if v else 0
+                print("  %-32s %7.2f ms/step (%4.1f%%)  %6.1f TF/s"
+                      % (k, v / steps, 100 * v / total, tf_s))
+            print("top loop fusions (elementwise; achieved GB/s):")
+            for k, v in loops.most_common(8):
+                bw = lbytes[k] / (v / steps * 1e-3) / 1e9 if v else 0
+                print("  %6.3f ms/step %5.0f GB/s  %s"
+                      % (v / steps, bw, k[:90]))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--logdir", default="/tmp/mxtpu_profile")
+    p.add_argument("--report-only", action="store_true")
+    args = p.parse_args()
+    if not args.report_only:
+        capture(args.batch, args.steps, args.logdir)
+    report(args.logdir, args.steps)
+
+
+if __name__ == "__main__":
+    main()
