@@ -89,8 +89,8 @@ def dot_product_attention(q, k, v, causal=False, scale=None, mask=None):
 @defop(
     "MultiHeadAttention",
     arg_names=("query", "key", "value"),
-    param_spec={"num_heads": 1, "causal": False, "use_rope": False,
-                "use_flash": True},
+    param_spec={"num_heads": 1, "num_kv_heads": 0, "causal": False,
+                "use_rope": False, "use_flash": True},
 )
 def _multi_head_attention(attrs, query, key, value):
     """Fused multi-head attention on (B, T, H*D) projected inputs.
@@ -99,21 +99,71 @@ def _multi_head_attention(attrs, query, key, value):
     merges heads. Projections (in/out) live outside this op as
     FullyConnected so tensor-parallel sharding of the head axis is a pure
     data layout (mxnet_tpu.parallel.tensor_parallel).
+
+    ``num_kv_heads`` < num_heads gives grouped-query attention (GQA;
+    =1 is multi-query): key/value carry (B, T, num_kv_heads*D) and each
+    kv head serves num_heads/num_kv_heads query heads. Where the flash
+    kernel is selected the kv heads are broadcast to full H for the
+    kernel (projection params/FLOPs still shrink); elsewhere a grouped
+    einsum keeps kv at hkv heads so KV bandwidth shrinks too. 0
+    (default) = standard MHA.
     """
     h = int(attrs["num_heads"])
+    hkv = int(attrs["num_kv_heads"]) or h
+    if h % hkv:
+        raise ValueError("num_heads %d not divisible by num_kv_heads %d"
+                         % (h, hkv))
     b, tq, dm = query.shape
     tk = key.shape[1]
     d = dm // h
+    causal = bool(attrs["causal"])
 
-    def split(x, t):
-        return x.reshape(b, t, h, d).transpose(0, 2, 1, 3)
+    def split(x, t, heads):
+        return x.reshape(b, t, heads, d).transpose(0, 2, 1, 3)
 
-    q, k, v = split(query, tq), split(key, tk), split(value, tk)
+    q = split(query, tq, h)
+    k, v = split(key, tk, hkv), split(value, tk, hkv)
     if attrs["use_rope"]:
         q, k = rope(q), rope(k)
+    if hkv != h:
+        from . import pallas as _pl
+        from .pallas import flash_attention as _fa
+
+        flash_selected = (bool(attrs["use_flash"]) and _pl.on_tpu()
+                          and _fa.kernel_qualifies(tq, tk, d)
+                          and tq >= _fa.MIN_SEQ)
+        if flash_selected:
+            # the kernel wants full-H tensors: broadcast each kv head
+            # over its query-head group (projection savings remain)
+            k = jnp.repeat(k, h // hkv, axis=1)
+            v = jnp.repeat(v, h // hkv, axis=1)
+            out = _fa.flash_attention(q, k, v, causal=causal)
+        else:
+            out = _grouped_attention(q, k, v, hkv, causal)
+        return out.transpose(0, 2, 1, 3).reshape(b, tq, dm)
     if attrs["use_flash"]:
         from .pallas import flash_attention as _fa
-        out = _fa.flash_attention(q, k, v, causal=bool(attrs["causal"]))
+        out = _fa.flash_attention(q, k, v, causal=causal)
     else:
-        out = dot_product_attention(q, k, v, causal=bool(attrs["causal"]))
+        out = dot_product_attention(q, k, v, causal=causal)
     return out.transpose(0, 2, 1, 3).reshape(b, tq, dm)
+
+
+def _grouped_attention(q, k, v, hkv, causal):
+    """GQA without materializing repeated kv: q (B, H, Tq, D) grouped as
+    (B, Hkv, G, Tq, D) against k/v (B, Hkv, Tk, D) — kv streams once per
+    GROUP, which is the bandwidth/KV-cache saving GQA exists for."""
+    b, hh, tq, d = q.shape
+    g = hh // hkv
+    q5 = q.reshape(b, hkv, g, tq, d)
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    logits = jnp.einsum("bkgqd,bkld->bkgql", q5, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        tk = logits.shape[-1]
+        idx_q = jnp.arange(tq)[:, None] + (tk - tq)
+        cmask = idx_q >= jnp.arange(tk)[None, :]
+        logits = jnp.where(cmask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgql,bkld->bkgqd", probs.astype(v.dtype), v)
+    return out.reshape(b, hh, tq, d)

@@ -294,3 +294,55 @@ def test_batchnorm_bf16_one_pass_path():
     np.testing.assert_allclose(np.asarray(new_mean, np.float32),
                                0.9 * np.asarray(mov_mean, np.float32) + 0.1 * mean,
                                rtol=0.05, atol=0.05)
+
+
+def test_multi_head_attention_gqa():
+    """Grouped-query / multi-query attention: num_kv_heads < num_heads
+    shares each kv head across a query-head group; equivalent to manually
+    repeating kv heads under standard MHA."""
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    b, t, h, hkv, d = 2, 8, 4, 2, 8
+    qv = rng.randn(b, t, h * d).astype(np.float32)
+    kv = rng.randn(b, t, hkv * d).astype(np.float32)
+    vv = rng.randn(b, t, hkv * d).astype(np.float32)
+
+    q = mx.sym.Variable("q")
+    k = mx.sym.Variable("k")
+    v = mx.sym.Variable("v")
+    gqa = mx.sym.MultiHeadAttention(query=q, key=k, value=v, num_heads=h,
+                                    num_kv_heads=hkv, causal=True)
+    exe = gqa.bind(mx.cpu(), {"q": mx.nd.array(qv), "k": mx.nd.array(kv),
+                              "v": mx.nd.array(vv)}, grad_req="null")
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (b, t, h * d)
+
+    # reference: repeat each kv head over its group -> standard MHA
+    def widen(x):
+        xs = x.reshape(b, t, hkv, d)
+        return np.repeat(xs, h // hkv, axis=2).reshape(b, t, h * d)
+
+    mha = mx.sym.MultiHeadAttention(query=q, key=k, value=v, num_heads=h,
+                                    causal=True)
+    exe2 = mha.bind(mx.cpu(), {"q": mx.nd.array(qv),
+                               "k": mx.nd.array(widen(kv)),
+                               "v": mx.nd.array(widen(vv))},
+                    grad_req="null")
+    ref = exe2.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    # MQA (one kv head) runs and grads flow to the narrow kv inputs
+    mqa = mx.sym.MultiHeadAttention(query=q, key=k, value=v, num_heads=h,
+                                    num_kv_heads=1, causal=True)
+    kv1 = rng.randn(b, t, d).astype(np.float32)
+    exe3 = mqa.bind(mx.cpu(), {"q": mx.nd.array(qv),
+                               "k": mx.nd.array(kv1),
+                               "v": mx.nd.array(kv1)},
+                    {"q": mx.nd.zeros(qv.shape),
+                     "k": mx.nd.zeros(kv1.shape),
+                     "v": mx.nd.zeros(kv1.shape)}, "write")
+    outs = exe3.forward(is_train=True)
+    exe3.backward([mx.nd.array(np.ones_like(outs[0].asnumpy()))])
+    g = exe3.grad_dict["k"].asnumpy()
+    assert g.shape == kv1.shape and np.abs(g).sum() > 0

@@ -80,9 +80,11 @@ def report(logdir, steps):
     space = xplane_pb2.XSpace()
     with open(xs[0], "rb") as f:
         space.ParseFromString(f.read())
+    found = False
     for plane in space.planes:
         if plane.name != "/device:TPU:0":
             continue
+        found = True
         stat_names = {k: v.name for k, v in plane.stat_metadata.items()}
         md = {}
         for k, v in plane.event_metadata.items():
@@ -115,7 +117,8 @@ def report(logdir, steps):
                     # key by FULL name: truncated keys can collide and
                     # merge distinct fusions' durations
                     loops[m["name"]] += dur
-                    lbytes[m["name"]] = m.get("bytes", 0)
+                    lbytes[m["name"]] = lbytes.get(m["name"], 0) \
+                        + m.get("bytes", 0)
             print("device total %.2f ms/step" % (total / steps))
             for k, v in cat.most_common(12):
                 tf_s = (fl[k] / steps) / (v / steps * 1e-3) / 1e12 if v else 0
@@ -123,9 +126,17 @@ def report(logdir, steps):
                       % (k, v / steps, 100 * v / total, tf_s))
             print("top loop fusions (elementwise; achieved GB/s):")
             for k, v in loops.most_common(8):
-                bw = lbytes[k] / (v / steps * 1e-3) / 1e9 if v else 0
+                bw = (lbytes[k] / steps) / (v / steps * 1e-3) / 1e9 if v else 0
                 print("  %6.3f ms/step %5.0f GB/s  %s"
                       % (v / steps, bw, k[:90]))
+    _check_found(found)
+
+
+def _check_found(found):
+    if not found:
+        raise SystemExit(
+            "no '/device:TPU:0' plane with an 'XLA Ops' line in the trace "
+            "— was the capture taken on a real single-chip TPU backend?")
 
 
 def main():
