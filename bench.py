@@ -49,6 +49,19 @@ REPEATS = max(1, int(float(os.environ.get("BENCH_REPEATS", "5"))))
 def run_config(batch, iters=None, repeats=None, remat=False):
     """Measure one (batch, remat) training config; returns the record
     dict. Used by the headline run and the BENCH_SWEEP table."""
+    _remat_set_here = remat and not os.environ.get("MXNET_BACKWARD_DO_MIRROR")
+    if _remat_set_here:
+        os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
+    try:
+        return _run_config_inner(batch, iters, repeats)
+    finally:
+        # even when the config OOMs mid-sweep, remat must not leak into
+        # the next config
+        if _remat_set_here:
+            os.environ.pop("MXNET_BACKWARD_DO_MIRROR", None)
+
+
+def _run_config_inner(batch, iters, repeats):
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -56,9 +69,6 @@ def run_config(batch, iters=None, repeats=None, remat=False):
     from mxnet_tpu import flops as flops_mod
     from mxnet_tpu import models
 
-    _remat_set_here = remat and not os.environ.get("MXNET_BACKWARD_DO_MIRROR")
-    if _remat_set_here:
-        os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
     # a user-set MXNET_BACKWARD_DO_MIRROR is honored (and recorded below),
     # never silently stripped
     remat = bool(os.environ.get("MXNET_BACKWARD_DO_MIRROR"))
@@ -160,6 +170,7 @@ def run_config(batch, iters=None, repeats=None, remat=False):
 
     rec = {
         "metric": "resnet50_train_mfu_bs%d" % batch,
+        "batch": batch,
         "value": round(100.0 * mfu, 2) if mfu is not None else round(imgs_per_sec, 2),
         "unit": "percent_of_bf16_peak" if mfu is not None else "images/sec",
         "vs_baseline": round(mfu / MFU_TARGET, 3) if mfu is not None
@@ -184,8 +195,6 @@ def run_config(batch, iters=None, repeats=None, remat=False):
         rec["metric"] = rec["metric"].replace("_mfu_", "_imgs_per_sec_")
     if per_iter_ms is not None:
         rec["per_iter_ms_synced"] = per_iter_ms
-    if _remat_set_here:  # don't leak into later sweep configs
-        os.environ.pop("MXNET_BACKWARD_DO_MIRROR", None)
     return rec
 
 
@@ -206,15 +215,21 @@ def main():
             except Exception as e:  # OOM etc.: record, keep sweeping
                 rec = {"metric": "resnet50_train_mfu_bs%d%s" % (
                            batch, "_remat" if remat else ""),
+                       "batch": batch,
                        "error": "%s: %s" % (type(e).__name__, e)}
             rows.append(rec)
             print(json.dumps(rec), flush=True)
-        # headline = the default-BATCH row regardless of metric flavor
-        # (img/s fallback included); else the first healthy row
+        # headline = the default-BATCH row, matched on the recorded batch
+        # field (metric-name suffix matching broke for _remat rows and
+        # for BENCH_BATCH values outside the sweep); else the first
+        # healthy row
         ok = [r for r in rows if "error" not in r]
-        headline = next((r for r in ok
-                         if r["metric"].endswith("_bs%d" % BATCH)),
+        headline = next((r for r in ok if r.get("batch") == BATCH),
                         ok[0] if ok else rows[-1])
+        if headline.get("batch") != BATCH:
+            print("bench: BENCH_BATCH=%d has no healthy sweep row; "
+                  "headline falls back to bs%s" % (BATCH, headline.get("batch")),
+                  file=sys.stderr)
         print(json.dumps(headline))
         return
     print(json.dumps(run_config(BATCH)))

@@ -399,6 +399,7 @@ class DevicePrefetchIter(DataIter):
         self._lock = threading.Lock()
         self._engine = engine
         self._iter_var = engine.get().new_variable()
+        self._closed = False
         self._done = False
         self._wedged = False  # a prefetch op failed to finish in time
         self._waiter = None   # reusable bounded-wait thread
@@ -517,11 +518,17 @@ class DevicePrefetchIter(DataIter):
             pass
 
     def reset(self):
+        if self._closed:
+            raise RuntimeError("DevicePrefetchIter is closed (its engine "
+                               "variable was retired); construct a new one")
         self._retire_worker()
         self._base.reset()
         self._start()
 
     def next(self):
+        if self._closed:
+            raise RuntimeError("DevicePrefetchIter is closed (its engine "
+                               "variable was retired); construct a new one")
         if self._done:
             raise StopIteration  # exhausted: the None sentinel is one-shot
         batch = self._q.get()
@@ -538,8 +545,14 @@ class DevicePrefetchIter(DataIter):
     def close(self):
         """Retire in-flight prefetch ops — call before interpreter
         shutdown: an engine op killed mid-device-transfer aborts the
-        process on some PJRT plugins."""
+        process on some PJRT plugins. Also retires the engine variable:
+        long-running jobs construct many iterators, and an undeleted var
+        per instance grows the engine's var table without bound."""
+        if getattr(self, "_closed", False):
+            return
         self._retire_worker()
+        self._engine.get().delete_variable(self._iter_var)
+        self._closed = True
 
     def __del__(self):
         try:

@@ -130,7 +130,7 @@ def _multi_head_attention(attrs, query, key, value):
         from .pallas import flash_attention as _fa
 
         flash_selected = (bool(attrs["use_flash"]) and _pl.on_tpu()
-                          and _fa.kernel_qualifies(tq, tk, d)
+                          and _fa.kernel_qualifies(tq, tk, d, causal=causal)
                           and tq >= _fa.MIN_SEQ)
         if flash_selected:
             # the kernel wants full-H tensors: broadcast each kv head

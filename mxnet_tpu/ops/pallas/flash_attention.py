@@ -36,10 +36,14 @@ _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, causal, scale,
-               block_k):
+               block_k, offset):
     """One (batch*head, q-block) grid cell. Writes O, and the per-row
     logsumexp when a ref for it is supplied (training forward — the
-    blocked backward needs it; inference skips the extra HBM write)."""
+    blocked backward needs it; inference skips the extra HBM write).
+
+    ``offset`` = tk - tq: causal masking aligns the LAST query with the
+    last key (kv-cache decode), matching the XLA paths' (tk - tq) query
+    offset (attention.py dot_product_attention / _grouped_attention)."""
     q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
     bq = q.shape[0]
     tk = k_ref.shape[1]
@@ -54,7 +58,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, causal, scale,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # (BQ, BK)
         if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(
+            q_pos = qi * bq + offset + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
@@ -74,8 +78,9 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, causal, scale,
             jnp.full((bq,), _NEG_INF, jnp.float32),
             jnp.zeros((bq,), jnp.float32))
     if causal:
-        # only blocks at or left of the diagonal contribute
-        hi = jax.lax.min(num_k_blocks, pl.cdiv((qi + 1) * bq, block_k))
+        # only blocks at or left of the (offset) diagonal contribute
+        hi = jax.lax.min(num_k_blocks,
+                         pl.cdiv((qi + 1) * bq + offset, block_k))
     else:
         hi = num_k_blocks
     acc, m, l = jax.lax.fori_loop(0, hi, body, init)
@@ -90,7 +95,7 @@ def _fa_forward(q, k, v, causal, scale, interpret, with_lse=False):
     block_q = min(BLOCK_Q, tq)
     block_k = min(BLOCK_K, tk)
     kernel = functools.partial(_fa_kernel, causal=causal, scale=scale,
-                               block_k=block_k)
+                               block_k=block_k, offset=tk - tq)
     kwargs = {}
     if pltpu is not None and not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(
@@ -127,7 +132,7 @@ def _fa_forward(q, k, v, causal, scale, interpret, with_lse=False):
 # --- blocked backward (FlashAttention-2 style: no S^2 materialization) ------
 
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
-                      dq_ref, *, causal, scale, block_k):
+                      dq_ref, *, causal, scale, block_k, offset):
     """dQ for one (batch*head, q-block): stream k/v blocks, rebuild p from
     the saved logsumexp, dq += (p * (dO v^T - D)) @ k * scale."""
     q = q_ref[0].astype(jnp.float32)               # (BQ, D)
@@ -145,7 +150,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(
+            q_pos = qi * bq + offset + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
@@ -157,7 +162,8 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
         return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
 
-    hi = (jax.lax.min(num_k_blocks, pl.cdiv((qi + 1) * bq, block_k))
+    hi = (jax.lax.min(num_k_blocks,
+                      pl.cdiv((qi + 1) * bq + offset, block_k))
           if causal else num_k_blocks)
     dq = jax.lax.fori_loop(0, hi, body,
                            jnp.zeros((bq, q.shape[1]), jnp.float32))
@@ -165,7 +171,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
 
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
-                       dk_ref, dv_ref, *, causal, scale, block_q):
+                       dk_ref, dv_ref, *, causal, scale, block_q, offset):
     """dK/dV for one (batch*head, k-block): stream q/dO blocks."""
     k = k_ref[0].astype(jnp.float32)               # (BK, D)
     v = v_ref[0].astype(jnp.float32)               # (BK, D)
@@ -183,7 +189,7 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+            q_pos = qb * block_q + offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 1)
@@ -198,9 +204,9 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
                                       preferred_element_type=jnp.float32)
         return dk, dv
 
-    # causal: q blocks strictly before this k block's start contribute
-    # nothing (every entry masked)
-    lo = (ki * bk) // block_q if causal else 0
+    # causal: q blocks whose last (offset) query position precedes this
+    # k block's start contribute nothing (every entry masked)
+    lo = (jax.lax.max(ki * bk - offset, 0) // block_q) if causal else 0
     d = k.shape[1]
     dk, dv = jax.lax.fori_loop(
         lo, num_q_blocks, body,
@@ -229,7 +235,7 @@ def _fa_backward(q, k, v, o, lse, do, causal, scale, interpret,
             dimension_semantics=("parallel", "arbitrary"))
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, causal=causal, scale=scale,
-                          block_k=block_k),
+                          block_k=block_k, offset=tk - tq),
         grid=(bh, pl.cdiv(tq, block_q)),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -251,7 +257,7 @@ def _fa_backward(q, k, v, o, lse, do, causal, scale, interpret,
     )(q, k, v, do, lse, dvec)
     dk, dv = pl.pallas_call(
         functools.partial(_fa_bwd_dkv_kernel, causal=causal, scale=scale,
-                          block_q=block_q),
+                          block_q=block_q, offset=tk - tq),
         grid=(bh, pl.cdiv(tk, block_k)),
         in_specs=[
             pl.BlockSpec((1, tq, d), lambda b, i: (b, 0, 0)),
@@ -285,13 +291,17 @@ def _aligned(t, block):
     return t % min(block, t) == 0
 
 
-def kernel_qualifies(tq, tk, d, compiled=True):
+def kernel_qualifies(tq, tk, d, compiled=True, causal=False):
     """The kernel's CORRECTNESS contract: sequence lengths divide into
     whole blocks (a ragged final block would read padding into the
     softmax); the compiled path additionally needs a lane-aligned
-    head_dim. Shared by flash_attention() and ring_attention's per-shard
-    selection so the two paths cannot drift."""
+    head_dim; causal calls need tq <= tk (with tq > tk the first tk-tq
+    query rows are FULLY masked — the XLA path's finfo.min masking
+    degrades to uniform attention there, while the kernel's l=0 would
+    produce NaN). Shared by flash_attention() and ring_attention's
+    per-shard selection so the two paths cannot drift."""
     return (_aligned(tq, BLOCK_Q) and _aligned(tk, BLOCK_K)
+            and (not causal or tq <= tk)
             and (not compiled or d % 128 == 0))
 
 
@@ -354,13 +364,14 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
     # perf threshold (auto mode only)
     if interpret is None:
         if not (on_tpu()
-                and kernel_qualifies(q.shape[-2], k.shape[-2], q.shape[-1])
+                and kernel_qualifies(q.shape[-2], k.shape[-2], q.shape[-1],
+                                     causal=causal)
                 and q.shape[-2] >= MIN_SEQ):
             return _att.dot_product_attention(q, k, v, causal=causal,
                                               scale=scale)
         interpret = False
     elif not kernel_qualifies(q.shape[-2], k.shape[-2], q.shape[-1],
-                              compiled=not interpret):
+                              compiled=not interpret, causal=causal):
         # explicit interpret=True/False forces the kernel past the
         # MIN_SEQ perf gate (tests/benches), but never past the block
         # contract

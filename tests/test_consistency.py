@@ -407,3 +407,46 @@ def test_pallas_flash_backward_multiblock_causal():
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
                 err_msg="%s causal=%s" % (name, causal))
+
+
+def test_pallas_flash_causal_cross_length_matches_xla():
+    """tq != tk with causal: the kernels offset queries by (tk - tq) so
+    the LAST query aligns with the last key — identical to the XLA
+    paths' kv-cache-decode convention (attention.py:80). Regression for
+    the round-3 advisor finding that the two paths silently disagreed."""
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention
+    from mxnet_tpu.ops.attention import dot_product_attention
+
+    rng = np.random.RandomState(7)
+    B, H, D = 1, 2, 8
+    for tq, tk in ((256, 512), (512, 768), (128, 256)):
+        q = jnp.asarray(rng.randn(B, H, tq, D).astype(np.float32))
+        k = jnp.asarray(rng.randn(B, H, tk, D).astype(np.float32))
+        v = jnp.asarray(rng.randn(B, H, tk, D).astype(np.float32))
+        got = flash_attention(q, k, v, causal=True, interpret=True)
+        want = dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg="fwd tq=%d tk=%d" % (tq, tk))
+        gf = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=True, interpret=True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gp = jax.grad(lambda q, k, v: jnp.sum(dot_product_attention(
+            q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gf, gp):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
+                err_msg="%s tq=%d tk=%d" % (name, tq, tk))
+    # tq > tk causal: fully-masked leading query rows (the kernel would
+    # NaN on l=0) — kernel_qualifies refuses and the wrapper falls back
+    # to the XLA path's finite uniform-attention degradation
+    from mxnet_tpu.ops.pallas.flash_attention import kernel_qualifies
+    assert not kernel_qualifies(512, 256, 8, compiled=False, causal=True)
+    q = jnp.asarray(rng.randn(B, H, 512, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, H, 256, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, H, 256, D).astype(np.float32))
+    got = flash_attention(q, k, v, causal=True, interpret=True)
+    want = dot_product_attention(q, k, v, causal=True)
+    assert np.isfinite(np.asarray(got)).all()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)

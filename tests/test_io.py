@@ -136,6 +136,14 @@ def test_device_prefetch_iter_basics():
     first = it.next()
     assert first.data[0].asnumpy()[0, 0] == 0.0
     it.close()
+    it.close()  # idempotent
+    # close() retires the engine variable — reuse must be a clear error,
+    # not engine ops on a freed native var
+    import pytest
+    with pytest.raises(RuntimeError, match="closed"):
+        it.reset()
+    with pytest.raises(RuntimeError, match="closed"):
+        it.next()
 
 
 def test_device_prefetch_overlap():
