@@ -30,6 +30,43 @@ from common import respect_jax_platforms  # noqa: E402
 respect_jax_platforms()
 
 
+def _min_time(jf, xs, reps):
+    """min-of-3 timed blocks of ``reps`` calls with a scalar-readback
+    sync. ``jf`` must reduce to a scalar INSIDE the jit: a fresh
+    (B,H,S,D) output buffer per execution costs ~160 ms/45 MB through
+    the dev tunnel (docs/perf.md LSTM caveat) and would swamp the
+    kernel time."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    r = jf(*xs)
+    np.asarray(jnp.reshape(r, (-1,))[0])
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            r = jf(*xs)
+        np.asarray(jnp.reshape(r, (-1,))[0])
+        t = (time.perf_counter() - t0) / reps
+        best = t if best is None else min(best, t)
+    return best
+
+
+def _fb_scalar(f):
+    """fwd+bwd closure: grads wrt ALL of q,k,v (argnums=0 alone would
+    let DCE drop the dkv kernel entirely), reduced to a scalar inside
+    the jit (same tunnel rule as the forward closures)."""
+    import jax
+    import jax.numpy as jnp
+
+    def scalar(q, k, v):
+        g = jax.grad(lambda q, k, v: jnp.sum(jnp.square(
+            f(q, k, v).astype(jnp.float32))),
+            argnums=(0, 1, 2))(q, k, v)
+        return sum(jnp.sum(x.astype(jnp.float32)) for x in g)
+    return jax.jit(scalar)
+
+
 def micro(args):
     import numpy as np
     import jax
@@ -70,21 +107,9 @@ def micro(args):
         op = np.asarray(plain_full(q, k, v), np.float32)
         maxdiff = np.abs(of - op).max()
 
-        def timeit(f, reps=3 if on_cpu else 200):
-            r = f(q, k, v)
-            np.asarray(jnp.reshape(r, (-1,))[0])
-            best = None
-            for _ in range(3):
-                t0 = time.perf_counter()
-                for _ in range(reps):
-                    r = f(q, k, v)
-                np.asarray(jnp.reshape(r, (-1,))[0])
-                t = (time.perf_counter() - t0) / reps
-                best = t if best is None else min(best, t)
-            return best
-
-        t_plain = timeit(plain)
-        t_flash = timeit(flash)
+        reps = 3 if on_cpu else 200
+        t_plain = _min_time(plain, (q, k, v), reps)
+        t_flash = _min_time(flash, (q, k, v), reps)
         # attention FLOPs: 2 matmuls of 2*B*H*S*S*D each (causal halves)
         flops = 4 * B * H * S * S * D * (0.5 if args.causal else 1.0)
         rows.append((B, H, S, D, t_plain, t_flash, maxdiff))
@@ -95,23 +120,12 @@ def micro(args):
                  flops / t_plain / 1e12, t_flash * 1e3,
                  flops / t_flash / 1e12, t_plain / t_flash, maxdiff))
 
-        # fwd+bwd: grads wrt ALL of q,k,v (argnums=0 alone would let DCE
-        # drop the dkv kernel entirely), reduced to a scalar INSIDE the
-        # jit (a fresh (B,H,S,D) output per rep pays the tunnel's
-        # fresh-buffer cost and swamps the kernel time — same rule as the
-        # forward closures above)
-        def fb(f):
-            def scalar(q, k, v):
-                g = jax.grad(lambda q, k, v: jnp.sum(jnp.square(
-                    f(q, k, v).astype(jnp.float32))),
-                    argnums=(0, 1, 2))(q, k, v)
-                return sum(jnp.sum(x.astype(jnp.float32)) for x in g)
-            return jax.jit(scalar)
-
-        tb_plain = timeit(fb(lambda q, k, v: att.dot_product_attention(
-            q, k, v, causal=args.causal)))
-        tb_flash = timeit(fb(lambda q, k, v: fa.flash_attention(
-            q, k, v, causal=args.causal, interpret=interp)))
+        tb_plain = _min_time(_fb_scalar(lambda q, k, v:
+            att.dot_product_attention(q, k, v, causal=args.causal)),
+            (q, k, v), reps)
+        tb_flash = _min_time(_fb_scalar(lambda q, k, v:
+            fa.flash_attention(q, k, v, causal=args.causal,
+                               interpret=interp)), (q, k, v), reps)
         # USEFUL work (same for both paths): bwd = 2.5x fwd (5 necessary
         # matmuls vs 2), total 3.5x — the flash kernels' score recompute
         # is deliberately NOT credited (standard flash accounting)
@@ -122,6 +136,72 @@ def micro(args):
                  tb_flash * 1e3, fb_flops / tb_flash / 1e12,
                  tb_plain / tb_flash))
     return rows
+
+
+def gqa(args):
+    """Grouped-query attention: native narrow-kv flash kernel vs (a) the
+    old repeat-kv-to-full-H flash path and (b) the XLA grouped einsum.
+    The native kernel's win is KV HBM traffic (h/hkv fewer K/V bytes),
+    so the gap grows with S and shrinks with hkv."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import attention as att
+    from mxnet_tpu.ops.pallas import flash_attention as fa
+
+    on_cpu = jax.default_backend() == "cpu"
+    interp = True if on_cpu else False
+    configs = ([(1, 4, 2, 256, 128)] if on_cpu else
+               [(4, 16, 4, 2048, 128), (4, 16, 2, 2048, 128),
+                (4, 16, 4, 4096, 128), (4, 16, 1, 4096, 128),
+                (1, 16, 2, 8192, 128)])
+    for (B, H, HKV, S, D) in configs:
+        g = H // HKV
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32),
+                        dtype=jnp.bfloat16)
+        k = jnp.asarray(rng.randn(B, HKV, S, D).astype(np.float32),
+                        dtype=jnp.bfloat16)
+        v = jnp.asarray(rng.randn(B, HKV, S, D).astype(np.float32),
+                        dtype=jnp.bfloat16)
+
+        def native(q, k, v):
+            return fa.flash_attention(q, k, v, causal=args.causal,
+                                      interpret=interp)
+
+        def repeat(q, k, v):
+            return fa.flash_attention(q, jnp.repeat(k, g, axis=1),
+                                      jnp.repeat(v, g, axis=1),
+                                      causal=args.causal, interpret=interp)
+
+        def einsum(q, k, v):
+            return att._grouped_attention(q, k, v, HKV, args.causal)
+
+        # on-chip equivalence first
+        base = np.asarray(jax.jit(einsum)(q, k, v), np.float32)
+        for name, f in (("native", native), ("repeat", repeat)):
+            out = np.asarray(jax.jit(f)(q, k, v), np.float32)
+            md = np.abs(out - base).max()
+            assert md < 3e-2, (name, md)
+
+        def timeit(f, reps=3 if on_cpu else 100):
+            return _min_time(jax.jit(lambda q, k, v: jnp.sum(
+                f(q, k, v).astype(jnp.float32))), (q, k, v), reps)
+
+        def timeit_fb(f, reps=3 if on_cpu else 50):
+            return _min_time(_fb_scalar(f), (q, k, v), reps)
+
+        tn, tr, te = timeit(native), timeit(repeat), timeit(einsum)
+        print("gqa B=%d H=%d HKV=%d S=%d D=%d causal=%s fwd: "
+              "native %.3f ms  repeat %.3f ms (%.2fx)  einsum %.3f ms "
+              "(%.2fx)"
+              % (B, H, HKV, S, D, args.causal, tn * 1e3, tr * 1e3,
+                 tr / tn, te * 1e3, te / tn))
+        tbn, tbr, tbe = (timeit_fb(native), timeit_fb(repeat),
+                         timeit_fb(einsum))
+        print("  fwd+bwd: native %.3f ms  repeat %.3f ms (%.2fx)  "
+              "einsum %.3f ms (%.2fx)"
+              % (tbn * 1e3, tbr * 1e3, tbr / tbn, tbe * 1e3, tbe / tbn))
 
 
 def _lm_symbol(vocab, num_layers, num_heads, dm, dff, use_flash):
@@ -236,7 +316,12 @@ def main():
                    default=True)
     p.add_argument("--skip-micro", action="store_true")
     p.add_argument("--skip-train", action="store_true")
+    p.add_argument("--gqa", action="store_true",
+                   help="run ONLY the grouped-query attention micro")
     args = p.parse_args()
+    if args.gqa:
+        gqa(args)
+        return
     if not args.skip_micro:
         micro(args)
     if not args.skip_train:

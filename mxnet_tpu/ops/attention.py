@@ -102,11 +102,11 @@ def _multi_head_attention(attrs, query, key, value):
 
     ``num_kv_heads`` < num_heads gives grouped-query attention (GQA;
     =1 is multi-query): key/value carry (B, T, num_kv_heads*D) and each
-    kv head serves num_heads/num_kv_heads query heads. Where the flash
-    kernel is selected the kv heads are broadcast to full H for the
-    kernel (projection params/FLOPs still shrink); elsewhere a grouped
-    einsum keeps kv at hkv heads so KV bandwidth shrinks too. 0
-    (default) = standard MHA.
+    kv head serves num_heads/num_kv_heads query heads. Both paths keep
+    kv at hkv heads end to end — the flash kernel grids query-head
+    groups over the VMEM-resident kv block, the XLA path uses a grouped
+    einsum — so KV HBM bandwidth shrinks by h/hkv along with the
+    projection params/FLOPs. 0 (default) = standard MHA.
     """
     h = int(attrs["num_heads"])
     hkv = int(attrs["num_kv_heads"]) or h
@@ -133,10 +133,9 @@ def _multi_head_attention(attrs, query, key, value):
                           and _fa.kernel_qualifies(tq, tk, d, causal=causal)
                           and tq >= _fa.MIN_SEQ)
         if flash_selected:
-            # the kernel wants full-H tensors: broadcast each kv head
-            # over its query-head group (projection savings remain)
-            k = jnp.repeat(k, h // hkv, axis=1)
-            v = jnp.repeat(v, h // hkv, axis=1)
+            # the kernel takes narrow (B, Hkv, Tk, D) k/v directly and
+            # grids query-head groups over the VMEM-resident kv block —
+            # K/V HBM traffic stays h/hkv lower, the point of GQA
             out = _fa.flash_attention(q, k, v, causal=causal)
         else:
             out = _grouped_attention(q, k, v, hkv, causal)
@@ -149,14 +148,15 @@ def _multi_head_attention(attrs, query, key, value):
     return out.transpose(0, 2, 1, 3).reshape(b, tq, dm)
 
 
-def _grouped_attention(q, k, v, hkv, causal):
+def _grouped_attention(q, k, v, hkv, causal, scale=None):
     """GQA without materializing repeated kv: q (B, H, Tq, D) grouped as
     (B, Hkv, G, Tq, D) against k/v (B, Hkv, Tk, D) — kv streams once per
     GROUP, which is the bandwidth/KV-cache saving GQA exists for."""
     b, hh, tq, d = q.shape
     g = hh // hkv
     q5 = q.reshape(b, hkv, g, tq, d)
-    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
     logits = jnp.einsum("bkgqd,bkld->bkgql", q5, k,
                         preferred_element_type=jnp.float32) * scale
     if causal:

@@ -37,17 +37,23 @@ _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, causal, scale,
                block_k, offset):
-    """One (batch*head, q-block) grid cell. Writes O, and the per-row
-    logsumexp when a ref for it is supplied (training forward — the
-    blocked backward needs it; inference skips the extra HBM write).
+    """One (batch*kv-head, group, q-block) grid cell. Writes O, and the
+    per-row logsumexp when a ref for it is supplied (training forward —
+    the blocked backward needs it; inference skips the extra HBM write).
+
+    Grouped-query layout: q is (B*Hkv, G, Tq, D) against k/v (B*Hkv, Tk,
+    D) — the G query heads sharing one kv head iterate in the grid's
+    middle dim while the k/v block index stays fixed, so K/V are fetched
+    into VMEM once per KV head, not once per query head (the h/hkv
+    HBM-bandwidth saving GQA exists for). G=1 is standard MHA.
 
     ``offset`` = tk - tq: causal masking aligns the LAST query with the
     last key (kv-cache decode), matching the XLA paths' (tk - tq) query
     offset (attention.py dot_product_attention / _grouped_attention)."""
-    q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
+    q = q_ref[0, 0].astype(jnp.float32) * scale       # (BQ, D)
     bq = q.shape[0]
     tk = k_ref.shape[1]
-    qi = pl.program_id(1)
+    qi = pl.program_id(2)
     num_k_blocks = pl.cdiv(tk, block_k)
 
     def body(kb, carry):
@@ -84,13 +90,15 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, causal, scale,
     else:
         hi = num_k_blocks
     acc, m, l = jax.lax.fori_loop(0, hi, body, init)
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
     if maybe_lse_ref:
-        maybe_lse_ref[0][0, 0] = m + jnp.log(l)
+        maybe_lse_ref[0][0, 0, 0] = m + jnp.log(l)
 
 
 def _fa_forward(q, k, v, causal, scale, interpret, with_lse=False):
-    bh, tq, d = q.shape
+    """q: (B*Hkv, G, Tq, D); k/v: (B*Hkv, Tk, D). Returns (B*Hkv, G, Tq,
+    D) [+ lse (B*Hkv, G, Tq)]."""
+    bkv, g, tq, d = q.shape
     tk = k.shape[1]
     block_q = min(BLOCK_Q, tq)
     block_k = min(BLOCK_K, tk)
@@ -99,29 +107,34 @@ def _fa_forward(q, k, v, causal, scale, interpret, with_lse=False):
     kwargs = {}
     if pltpu is not None and not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"))
-    out_specs = [pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))]
-    out_shape = [jax.ShapeDtypeStruct((bh, tq, d), q.dtype)]
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"))
+    out_specs = [pl.BlockSpec((1, 1, block_q, d),
+                              lambda b, gi, i: (b, gi, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((bkv, g, tq, d), q.dtype)]
     if with_lse:
-        # (bh, 1, tq): TPU block rules need the last two dims (1, BQ)
-        # where 1 equals the array dim and BQ is lane-aligned
-        out_specs.append(pl.BlockSpec((1, 1, block_q),
-                                      lambda b, i: (b, 0, i)))
-        out_shape.append(jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32))
+        # (bkv, g, 1, tq): TPU block rules need the last two block dims
+        # divisible by (8, 128) or EQUAL to the array dims — the
+        # singleton third dim gives (1, BQ) blocks with 1 == array dim
+        out_specs.append(pl.BlockSpec((1, 1, 1, block_q),
+                                      lambda b, gi, i: (b, gi, 0, i)))
+        out_shape.append(jax.ShapeDtypeStruct((bkv, g, 1, tq),
+                                              jnp.float32))
     res = pl.pallas_call(
         kernel,
-        grid=(bh, pl.cdiv(tq, block_q)),
+        grid=(bkv, g, pl.cdiv(tq, block_q)),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, gi, i: (b, gi, i, 0)),
+            # k/v block index ignores (gi, i): Pallas re-fetches only on
+            # index change, so K/V stream from HBM once per KV head
+            pl.BlockSpec((1, tk, d), lambda b, gi, i: (b, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, gi, i: (b, 0, 0)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
         cost_estimate=pl.CostEstimate(
-            flops=4 * bh * tq * tk * d,
+            flops=4 * bkv * g * tq * tk * d,
             bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
-            transcendentals=bh * tq * tk,
+            transcendentals=bkv * g * tq * tk,
         ),
         interpret=interpret,
         **kwargs,
@@ -133,15 +146,16 @@ def _fa_forward(q, k, v, causal, scale, interpret, with_lse=False):
 
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
                       dq_ref, *, causal, scale, block_k, offset):
-    """dQ for one (batch*head, q-block): stream k/v blocks, rebuild p from
-    the saved logsumexp, dq += (p * (dO v^T - D)) @ k * scale."""
-    q = q_ref[0].astype(jnp.float32)               # (BQ, D)
-    do = do_ref[0].astype(jnp.float32)             # (BQ, D)
-    lse = lse_ref[0, 0]                            # (BQ,)
-    dvec = dvec_ref[0, 0]                          # (BQ,)
+    """dQ for one (batch*kv-head, group, q-block): stream k/v blocks,
+    rebuild p from the saved logsumexp, dq += (p * (dO v^T - D)) @ k *
+    scale."""
+    q = q_ref[0, 0].astype(jnp.float32)            # (BQ, D)
+    do = do_ref[0, 0].astype(jnp.float32)          # (BQ, D)
+    lse = lse_ref[0, 0, 0]                         # (BQ,)
+    dvec = dvec_ref[0, 0, 0]                       # (BQ,)
     bq = q.shape[0]
     tk = k_ref.shape[1]
-    qi = pl.program_id(1)
+    qi = pl.program_id(2)
     num_k_blocks = pl.cdiv(tk, block_k)
 
     def body(kb, dq):
@@ -167,25 +181,38 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
           if causal else num_k_blocks)
     dq = jax.lax.fori_loop(0, hi, body,
                            jnp.zeros((bq, q.shape[1]), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
                        dk_ref, dv_ref, *, causal, scale, block_q, offset):
-    """dK/dV for one (batch*head, k-block): stream q/dO blocks."""
+    """dK/dV for one (batch*kv-head, k-block) pair: stream q/dO blocks.
+    The grid's LAST dim iterates the query-head group sequentially,
+    accumulating each group head's contribution into the same dk/dv
+    block (the GQA kv gradient is the sum over its group).
+
+    Known tradeoff of this layout: the q/do/lse/dvec block index changes
+    every grid step, so those are re-fetched num_k_blocks times per
+    group head (vs once in a (bkv, g, kb)-ordered grid — which would
+    break the dk/dv accumulation, since Pallas only accumulates across
+    CONSECUTIVE revisits of an output block). The kernel is MXU-bound at
+    every selected shape, so the extra q-side DMA rides otherwise-idle
+    bandwidth: measured fwd+bwd stays within 1-3% of the old full-H
+    layout while temp HBM drops g-fold (docs/perf.md GQA table)."""
     k = k_ref[0].astype(jnp.float32)               # (BK, D)
     v = v_ref[0].astype(jnp.float32)               # (BK, D)
     bk = k.shape[0]
-    tq = q_ref.shape[1]
+    tq = q_ref.shape[2]
     ki = pl.program_id(1)
+    gi = pl.program_id(2)
     num_q_blocks = pl.cdiv(tq, block_q)
 
     def body(qb, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
-        dvec = dvec_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        q = q_ref[0, 0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, 0, pl.ds(qb * block_q, block_q)]
+        dvec = dvec_ref[0, 0, 0, pl.ds(qb * block_q, block_q)]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -211,13 +238,27 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
     dk, dv = jax.lax.fori_loop(
         lo, num_q_blocks, body,
         (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    dk = (dk * scale).astype(dk_ref.dtype)
+    dv = dv.astype(dv_ref.dtype)
+
+    # first group head initializes the output block; later ones add
+    @pl.when(gi == 0)
+    def _init():
+        dk_ref[0] = dk
+        dv_ref[0] = dv
+
+    @pl.when(gi > 0)
+    def _accum():
+        dk_ref[0] += dk
+        dv_ref[0] += dv
 
 
 def _fa_backward(q, k, v, o, lse, do, causal, scale, interpret,
                  g_lse=None):
-    bh, tq, d = q.shape
+    """q/o/do: (B*Hkv, G, Tq, D); k/v: (B*Hkv, Tk, D); lse: (B*Hkv, G,
+    Tq). Returns (dq like q, dk/dv like k/v) — dk/dv already summed over
+    the query-head group inside the kernel."""
+    bkv, g, tq, d = q.shape
     tk = k.shape[1]
     block_q = min(BLOCK_Q, tq)
     block_k = min(BLOCK_K, tk)
@@ -226,65 +267,70 @@ def _fa_backward(q, k, v, o, lse, do, causal, scale, interpret,
     # +g_lse*p, i.e. D := D - g_lse (ring attention's merge
     # differentiates through lse).
     dvec = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                   axis=-1)[:, None, :]            # (bh, 1, tq)
+                   axis=-1)[:, :, None, :]         # (bkv, g, 1, tq)
     if g_lse is not None:
         dvec = dvec - g_lse.astype(jnp.float32)
     kwargs = {}
     if pltpu is not None and not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"))
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"))
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, causal=causal, scale=scale,
                           block_k=block_k, offset=tk - tq),
-        grid=(bh, pl.cdiv(tq, block_q)),
+        grid=(bkv, g, pl.cdiv(tq, block_q)),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, gi, i: (b, gi, i, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, gi, i: (b, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, gi, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, gi, i: (b, gi, i, 0)),
+            pl.BlockSpec((1, 1, 1, block_q), lambda b, gi, i: (b, gi, 0, i)),
+            pl.BlockSpec((1, 1, 1, block_q), lambda b, gi, i: (b, gi, 0, i)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b, gi, i: (b, gi, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bkv, g, tq, d), q.dtype),
         cost_estimate=pl.CostEstimate(
-            flops=6 * bh * tq * tk * d,
+            flops=6 * bkv * g * tq * tk * d,
             bytes_accessed=(q.size + k.size + v.size + do.size)
             * q.dtype.itemsize,
-            transcendentals=bh * tq * tk),
+            transcendentals=bkv * g * tq * tk),
         interpret=interpret,
         **kwargs,
     )(q, k, v, do, lse, dvec)
+    # dk/dv accumulate over the group inside the kernel; for g > 1 the
+    # running sum lives in the output block, so keep it f32 and cast
+    # after (bf16 += per group head would round g times)
+    kv_acc_dtype = k.dtype if g == 1 else jnp.float32
     dk, dv = pl.pallas_call(
         functools.partial(_fa_bwd_dkv_kernel, causal=causal, scale=scale,
                           block_q=block_q, offset=tk - tq),
-        grid=(bh, pl.cdiv(tk, block_k)),
+        grid=(bkv, pl.cdiv(tk, block_k), g),
         in_specs=[
-            pl.BlockSpec((1, tq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, tq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, tq), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, tq), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, tq, d), lambda b, i, gi: (b, gi, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, gi: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, gi: (b, i, 0)),
+            pl.BlockSpec((1, 1, tq, d), lambda b, i, gi: (b, gi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, tq), lambda b, i, gi: (b, gi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, tq), lambda b, i, gi: (b, gi, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, gi: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, gi: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+            jax.ShapeDtypeStruct((bkv, tk, d), kv_acc_dtype),
+            jax.ShapeDtypeStruct((bkv, tk, d), kv_acc_dtype),
         ],
         cost_estimate=pl.CostEstimate(
             # 4 matmuls per (q,k) tile pair: s, p^T@dO, dO@v^T, ds^T@q
-            flops=8 * bh * tq * tk * d,
+            flops=8 * bkv * g * tq * tk * d,
             bytes_accessed=(q.size + k.size + v.size + do.size)
             * q.dtype.itemsize,
-            transcendentals=bh * tq * tk),
+            transcendentals=bkv * g * tq * tk),
         interpret=interpret,
         **kwargs,
     )(q, k, v, do, lse, dvec)
-    return dq, dk, dv
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 def _aligned(t, block):
@@ -330,11 +376,12 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_with_lse(q3, k3, v3, causal, scale, interpret):
-    """(out, lse (bh,1,tq)) variant — ring attention's per-shard compute
-    merges across shards using the logsumexp, so lse is a REAL output
-    with its own cotangent here (folded into the D-vector in backward)."""
-    return _fa_forward(q3, k3, v3, causal, scale, interpret, with_lse=True)
+def _flash_with_lse(q4, k3, v3, causal, scale, interpret):
+    """(out, lse (bkv, g, tq)) variant — ring attention's per-shard
+    compute merges across shards using the logsumexp, so lse is a REAL
+    output with its own cotangent here (folded into the D-vector in
+    backward)."""
+    return _fa_forward(q4, k3, v3, causal, scale, interpret, with_lse=True)
 
 
 def _flash_with_lse_fwd(q3, k3, v3, causal, scale, interpret):
@@ -354,32 +401,50 @@ _flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
 
 
 def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
-    """Attention over (B, H, T, D). Pallas on TPU, XLA reference otherwise."""
+    """Attention over q (B, H, T, D). Pallas on TPU, XLA reference
+    otherwise.
+
+    k/v may carry FEWER heads (B, Hkv, Tk, D) with Hkv dividing H
+    (grouped-query / multi-query attention): the kernel grids the query
+    heads of a group over the same VMEM-resident K/V block, so K/V HBM
+    traffic shrinks by h/hkv — no jnp.repeat materialization. Query head
+    i attends kv head i // (H/Hkv) (consecutive q heads share a kv head,
+    the same convention as attention.py's grouped einsum)."""
     from .. import attention as _att
     from . import on_tpu
 
+    b, h, tq, d = q.shape
+    hkv, tk = k.shape[1], k.shape[2]
+    if h % hkv:
+        raise ValueError("q heads %d not divisible by kv heads %d"
+                         % (h, hkv))
     if scale is None:
-        scale = 1.0 / (q.shape[-1] ** 0.5)
+        scale = 1.0 / (d ** 0.5)
+
+    def fallback():
+        if hkv != h:
+            return _att._grouped_attention(q, k, v, hkv, causal,
+                                           scale=scale)
+        return _att.dot_product_attention(q, k, v, causal=causal,
+                                          scale=scale)
+
     # kernel_qualifies = the correctness contract; MIN_SEQ = the measured
     # perf threshold (auto mode only)
     if interpret is None:
         if not (on_tpu()
-                and kernel_qualifies(q.shape[-2], k.shape[-2], q.shape[-1],
-                                     causal=causal)
-                and q.shape[-2] >= MIN_SEQ):
-            return _att.dot_product_attention(q, k, v, causal=causal,
-                                              scale=scale)
+                and kernel_qualifies(tq, tk, d, causal=causal)
+                and tq >= MIN_SEQ):
+            return fallback()
         interpret = False
-    elif not kernel_qualifies(q.shape[-2], k.shape[-2], q.shape[-1],
-                              compiled=not interpret, causal=causal):
+    elif not kernel_qualifies(tq, tk, d, compiled=not interpret,
+                              causal=causal):
         # explicit interpret=True/False forces the kernel past the
         # MIN_SEQ perf gate (tests/benches), but never past the block
         # contract
-        return _att.dot_product_attention(q, k, v, causal=causal,
-                                          scale=scale)
+        return fallback()
 
-    b, h, tq, d = q.shape
-    tk = k.shape[2]
-    out = _flash(q.reshape(b * h, tq, d), k.reshape(b * h, tk, d),
-                 v.reshape(b * h, tk, d), causal, scale, interpret)
+    g = h // hkv
+    out = _flash(q.reshape(b * hkv, g, tq, d),
+                 k.reshape(b * hkv, tk, d),
+                 v.reshape(b * hkv, tk, d), causal, scale, interpret)
     return out.reshape(b, h, tq, d)

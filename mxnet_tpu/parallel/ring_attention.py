@@ -74,8 +74,9 @@ def _block_attn_flash(q, k, v, mode, interpret=False):
 
     def run(is_causal):
         def f():
+            # grouped-kernel layout with group size 1 (q: (bh, 1, t, d))
             out, lse = _flash_with_lse(
-                q.reshape(b * h, t, d), k.reshape(b * h, t, d),
+                q.reshape(b * h, 1, t, d), k.reshape(b * h, t, d),
                 v.reshape(b * h, t, d), is_causal, scale, interpret)
             return (out.reshape(b, h, t, d).astype(jnp.float32),
                     lse.reshape(b, h, t),

@@ -409,6 +409,41 @@ def test_pallas_flash_backward_multiblock_causal():
                 err_msg="%s causal=%s" % (name, causal))
 
 
+def test_pallas_flash_gqa_matches_grouped_einsum():
+    """Narrow-kv (GQA/MQA) flash: the kernel grids query-head groups
+    over one VMEM-resident kv block — fwd and all three grads must match
+    the XLA grouped einsum, with dk/dv at the NARROW (hkv) width (summed
+    over each group inside the kernel)."""
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention
+    from mxnet_tpu.ops.attention import _grouped_attention
+
+    rng = np.random.RandomState(11)
+    B, D = 2, 8
+    for h, hkv, tq, tk, causal in ((4, 2, 256, 256, True),
+                                   (4, 2, 256, 512, True),
+                                   (8, 1, 256, 256, False),   # MQA
+                                   (6, 3, 512, 512, True)):   # 2 q-blocks
+        q = jnp.asarray(rng.randn(B, h, tq, D).astype(np.float32))
+        k = jnp.asarray(rng.randn(B, hkv, tk, D).astype(np.float32))
+        v = jnp.asarray(rng.randn(B, hkv, tk, D).astype(np.float32))
+        got = flash_attention(q, k, v, causal=causal, interpret=True)
+        want = _grouped_attention(q, k, v, hkv, causal)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4,
+            err_msg="fwd h=%d hkv=%d tq=%d tk=%d" % (h, hkv, tq, tk))
+        gf = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=causal, interpret=True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gp = jax.grad(lambda q, k, v: jnp.sum(_grouped_attention(
+            q, k, v, hkv, causal) ** 2), argnums=(0, 1, 2))(q, k, v)
+        assert gf[1].shape == (B, hkv, tk, D)  # narrow kv grads
+        for name, a, b in zip("qkv", gf, gp):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=5e-4,
+                err_msg="%s h=%d hkv=%d tq=%d tk=%d" % (name, h, hkv,
+                                                        tq, tk))
+
+
 def test_pallas_flash_causal_cross_length_matches_xla():
     """tq != tk with causal: the kernels offset queries by (tk - tq) so
     the LAST query aligns with the last key — identical to the XLA
