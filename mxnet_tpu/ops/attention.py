@@ -125,24 +125,16 @@ def _multi_head_attention(attrs, query, key, value):
     k, v = split(key, tk, hkv), split(value, tk, hkv)
     if attrs["use_rope"]:
         q, k = rope(q), rope(k)
-    if hkv != h:
-        from . import pallas as _pl
-        from .pallas import flash_attention as _fa
-
-        flash_selected = (bool(attrs["use_flash"]) and _pl.on_tpu()
-                          and _fa.kernel_qualifies(tq, tk, d, causal=causal)
-                          and tq >= _fa.MIN_SEQ)
-        if flash_selected:
-            # the kernel takes narrow (B, Hkv, Tk, D) k/v directly and
-            # grids query-head groups over the VMEM-resident kv block —
-            # K/V HBM traffic stays h/hkv lower, the point of GQA
-            out = _fa.flash_attention(q, k, v, causal=causal)
-        else:
-            out = _grouped_attention(q, k, v, hkv, causal)
-        return out.transpose(0, 2, 1, 3).reshape(b, tq, dm)
     if attrs["use_flash"]:
+        # flash_attention owns the selection gate (on-TPU + block
+        # contract + MIN_SEQ) and takes narrow (B, Hkv, Tk, D) k/v
+        # directly — off the fast path it falls back to the grouped
+        # einsum / reference math itself, so the predicate lives in ONE
+        # place and the two layers cannot drift
         from .pallas import flash_attention as _fa
         out = _fa.flash_attention(q, k, v, causal=causal)
+    elif hkv != h:
+        out = _grouped_attention(q, k, v, hkv, causal)
     else:
         out = dot_product_attention(q, k, v, causal=causal)
     return out.transpose(0, 2, 1, 3).reshape(b, tq, dm)

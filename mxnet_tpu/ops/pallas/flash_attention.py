@@ -97,7 +97,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, causal, scale,
 
 def _fa_forward(q, k, v, causal, scale, interpret, with_lse=False):
     """q: (B*Hkv, G, Tq, D); k/v: (B*Hkv, Tk, D). Returns (B*Hkv, G, Tq,
-    D) [+ lse (B*Hkv, G, Tq)]."""
+    D) [+ lse (B*Hkv, G, 1, Tq) — the singleton keeps the last two block
+    dims TPU-tileable]."""
     bkv, g, tq, d = q.shape
     tk = k.shape[1]
     block_q = min(BLOCK_Q, tq)
@@ -255,7 +256,7 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
 
 def _fa_backward(q, k, v, o, lse, do, causal, scale, interpret,
                  g_lse=None):
-    """q/o/do: (B*Hkv, G, Tq, D); k/v: (B*Hkv, Tk, D); lse: (B*Hkv, G,
+    """q/o/do: (B*Hkv, G, Tq, D); k/v: (B*Hkv, Tk, D); lse: (B*Hkv, G, 1,
     Tq). Returns (dq like q, dk/dv like k/v) — dk/dv already summed over
     the query-head group inside the kernel."""
     bkv, g, tq, d = q.shape
@@ -377,7 +378,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash_with_lse(q4, k3, v3, causal, scale, interpret):
-    """(out, lse (bkv, g, tq)) variant — ring attention's per-shard
+    """(out, lse (bkv, g, 1, tq)) variant — ring attention's per-shard
     compute merges across shards using the logsumexp, so lse is a REAL
     output with its own cotangent here (folded into the D-vector in
     backward)."""

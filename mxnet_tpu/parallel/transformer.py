@@ -7,7 +7,9 @@ demonstration that the mesh axes from mesh.py all work together:
 - "data":  batch sharding; gradient psum (the kvstore-'device' analogue,
            SURVEY §5.8).
 - "seq":   ring attention over sequence chunks (ring_attention.py).
-- "pipe":  GPipe shift-register over layer stages (pipeline.py).
+- "pipe":  1F1B (default; O(n_stages) live activations) or GPipe
+           shift-register over layer stages (pipeline.py,
+           cfg.pipeline_schedule).
 - "model": Megatron-style tensor parallelism — QKV/FFN-in weights
            column-sharded, out-proj/FFN-out row-sharded, one psum per
            block half.
@@ -29,7 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from .moe import EXPERT_GROUP, scale_expert_grads, switch_moe_local
-from .pipeline import spmd_pipeline_local
+from .pipeline import spmd_pipeline_local, spmd_pipeline_local_1f1b
 from .ring_attention import _ring_attn_local
 
 
@@ -48,6 +50,14 @@ class TransformerConfig:
     moe: bool = False
     n_experts_local: int = 2
     capacity_factor: float = 2.0
+    # Switch load-balancing loss coefficient (Switch Transformer's 1e-2).
+    # Capacity bounds DROP overflow tokens when routing collapses; the aux
+    # loss is what keeps routing balanced so they rarely drop
+    # (tests/test_parallel.py::test_moe_aux_loss_keeps_routing_balanced).
+    moe_aux_coef: float = 1e-2
+    # "1f1b" (default: live activations O(n_stages), pipeline.py) or
+    # "gpipe" (scan-through-backward baseline).
+    pipeline_schedule: str = "1f1b"
 
 
 # Parameters carrying a leading pipeline-stage axis (sharded over "pipe").
@@ -138,26 +148,30 @@ def _layer(p, x, cfg: TransformerConfig, li):
     o = jax.lax.psum(o, "model")
     x = x + o
     h = _ln(x, p["ln2"][li])
+    aux = jnp.zeros((), jnp.float32)
     if cfg.moe:
         bb, tt, dd = h.shape
-        # Switch-MoE over the (data, expert, seq) expert group; the router
-        # aux loss is dropped here (capacity bounds enforce balance) —
-        # standalone users get it from switch_moe_local directly.
-        y, _aux = switch_moe_local(
+        # Switch-MoE over the (data, expert, seq) expert group; the
+        # router's load-balancing aux loss rides the pipeline's aux
+        # channel into the training loss (cfg.moe_aux_coef)
+        y, aux = switch_moe_local(
             h.reshape(bb * tt, dd), p["wg"][li], p["w1e"][li], p["w2e"][li],
             capacity_factor=cfg.capacity_factor)
         h = y.reshape(bb, tt, dd)
+        aux = aux.astype(jnp.float32)
     else:
         h = jax.nn.gelu(h @ p["w1"][li])
         h = h @ p["w2"][li]
         h = jax.lax.psum(h, "model")
-    return x + h
+    return x + h, aux
 
 
 def _stage_fn(stage_params, h, cfg: TransformerConfig):
+    aux = jnp.zeros((), jnp.float32)
     for li in range(cfg.layers_per_stage):
-        h = _layer(stage_params, h, cfg, li)
-    return h
+        h, a = _layer(stage_params, h, cfg, li)
+        aux = aux + a
+    return h, aux
 
 
 def make_train_step(mesh: Mesh, cfg: TransformerConfig, n_micro: int = None,
@@ -188,7 +202,16 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, n_micro: int = None,
 
         stage_params = {k2: params[k2] for k2 in _STAGE_KEYS
                         if k2 in params}
-        out = spmd_pipeline_local(stage, stage_params, x_mb, axis="pipe")
+        if cfg.pipeline_schedule == "1f1b":
+            out, aux = spmd_pipeline_local_1f1b(
+                stage, stage_params, x_mb, "pipe", True)
+        else:
+            out, aux = spmd_pipeline_local(
+                stage, stage_params, x_mb, axis="pipe", with_aux=True,
+                broadcast_out=False)
+        # `out` is valid ONLY on the last pipe rank (no activation-buffer
+        # broadcast): the head + loss run there and a SCALAR psum
+        # replaces the old (n_micro, mb, t, d) psum
         out = out.reshape((b,) + out.shape[2:])
         out = _ln(out, params["lnf"])
         logits = out @ params["unembed"]             # (b, t, v/tp) TP-sharded
@@ -208,16 +231,24 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, n_micro: int = None,
             logits, jnp.clip(tloc, 0, vloc - 1)[..., None], axis=-1)[..., 0]
         tgt_logit = jax.lax.psum(jnp.where(in_shard, tgt_logit, 0.0), "model")
         nll = jnp.log(denom) + mx_all - tgt_logit
-        # LOCAL mean; the cross-(data,seq) mean happens on the gradients
-        return jnp.mean(nll)
+        pipe_idx = jax.lax.axis_index("pipe")
+        ce = jax.lax.psum(
+            jnp.where(pipe_idx == n_pipe - 1, jnp.mean(nll), 0.0), "pipe")
+        # Switch aux: mean over (microbatch, stage, layer) contributions,
+        # weighted into the trained objective (Switch Transformer's ~1e-2)
+        aux_mean = aux / (n_micro * n_pipe * cfg.layers_per_stage)
+        # LOCAL losses; the cross-(data,seq) mean happens on the gradients.
+        # The CE is returned separately so callers still see the model
+        # loss; the OPTIMIZED objective is ce + coef*aux.
+        return ce + cfg.moe_aux_coef * aux_mean, ce
 
     batch_spec = P(("data", "expert"), "seq")
     in_specs = (specs, batch_spec, batch_spec)
     dp_axes = ("data", "expert", "seq")
 
     def step(params, tokens, targets):
-        loss, grads = jax.value_and_grad(
-            lambda p: local_fwd(p, tokens, targets))(params)
+        (_, loss), grads = jax.value_and_grad(
+            lambda p: local_fwd(p, tokens, targets), has_aux=True)(params)
         # DP/SP gradient all-reduce — the in-graph kvstore push/pull
         # (SURVEY §5.8: CommDevice reduce ≡ psum over ICI). Expert-sharded
         # weights hold DIFFERENT experts per rank: AD already summed the
@@ -225,9 +256,11 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, n_micro: int = None,
         # they take a 1/G scale instead of a pmean (moe.scale_expert_grads).
         grads = scale_expert_grads(grads, EXPERT_KEYS, group=dp_axes)
         # embed's cotangent only reaches pipe rank 0 (the pipeline ingests
-        # x there); psum makes it whole. unembed/lnf grads are computed
-        # identically on every pipe rank (post-broadcast graph) — no-op.
-        grads["embed"] = jax.lax.psum(grads["embed"], "pipe")
+        # x there); unembed/lnf cotangents only reach the LAST pipe rank
+        # (the head + loss are rank-masked there — no activation-buffer
+        # broadcast). psum over "pipe" makes each whole/replicated.
+        for k in ("embed", "unembed", "lnf"):
+            grads[k] = jax.lax.psum(grads[k], "pipe")
         new_params = jax.tree_util.tree_map(
             lambda p, g: (p - lr * g).astype(p.dtype), params, grads)
         loss = jax.lax.pmean(loss, dp_axes)
