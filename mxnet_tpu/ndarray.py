@@ -538,6 +538,14 @@ def save(fname: str, data) -> None:
 
 
 def load(fname: str):
+    # reference-ecosystem .params (dmlc blob, magic 0x112) loads through
+    # interop.py; our own container is npz
+    with open(fname, "rb") as fh:
+        head = fh.read(8)
+    from . import interop
+
+    if interop.is_reference_params(head):
+        return interop.load_params(fname)
     with np.load(fname, allow_pickle=False) as f:
         fmt = str(f["__format__"]) if "__format__" in f else "dict"
         if fmt == "list":

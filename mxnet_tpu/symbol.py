@@ -667,6 +667,12 @@ def load_json(json_str: str) -> Symbol:
     from .base import coerce_attr
 
     data = json.loads(json_str)
+    from . import interop
+    if interop.is_reference_symbol_json(data):
+        # a reference-ecosystem symbol dump (any legacy version):
+        # interop.py applies the upgrade semantics of the reference's
+        # legacy_json_util.cc
+        return interop.load_symbol_json(data)
     nodes: List[_Node] = []
     for jn in data["nodes"]:
         if jn["op"] == "null":

@@ -1,0 +1,139 @@
+"""Generate the vendored reference-format checkpoint fixtures.
+
+The binary layout is hand-constructed with struct.pack following the
+reference sources (NOT via mxnet_tpu.interop.save_params, so the reader
+test is not self-referential):
+- container: src/ndarray/ndarray.cc:673-683 (uint64 magic 0x112 +
+  uint64 reserved + vector<NDArray> + vector<string>)
+- per array:  src/ndarray/ndarray.cc:616-639 (TShape uint32 ndim +
+  uint32 extents, Context int32 dev_type + int32 dev_id, int32
+  type_flag, raw data)
+- strings:    dmlc serializer (uint64 count; uint64 len + bytes each)
+
+The JSON mimics a v0.9.5 nnvm graph dump (nodes with "attr"
+string-valued dicts, arg_nodes, node_row_ptr, heads, graph attrs with
+mxnet_version), and a second v0.8-style file drops the BatchNorm aux
+inputs and uses bare hidden keys, exercising the legacy upgrade path
+(src/nnvm/legacy_json_util.cc).
+
+Run from the repo root: python tests/fixtures/make_reference_fixture.py
+"""
+import json
+import os
+import struct
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def pack_legacy_ndarray(a):
+    out = [struct.pack("<I", a.ndim),
+           struct.pack("<%dI" % a.ndim, *a.shape),
+           struct.pack("<ii", 1, 0),          # Context: cpu(0)
+           struct.pack("<i", 0),              # type_flag kFloat32
+           np.ascontiguousarray(a.astype(np.float32)).tobytes()]
+    return b"".join(out)
+
+
+def pack_params(named):
+    out = [struct.pack("<QQ", 0x112, 0), struct.pack("<Q", len(named))]
+    out += [pack_legacy_ndarray(a) for _, a in named]
+    out.append(struct.pack("<Q", len(named)))
+    for n, _ in named:
+        b = n.encode()
+        out.append(struct.pack("<Q", len(b)) + b)
+    return b"".join(out)
+
+
+def node(op, name, attr=None, inputs=()):
+    d = {"op": op, "name": name, "inputs": [list(e) for e in inputs]}
+    if attr:
+        d["attr"] = attr
+    return d
+
+
+def main():
+    rng = np.random.RandomState(42)
+
+    # --- v0.9-style symbol JSON (aux inputs present) ---
+    nodes = [
+        node("null", "data"),                                        # 0
+        node("null", "conv_weight", {"__lr_mult__": "2.0"}),         # 1
+        node("null", "conv_bias"),                                   # 2
+        node("Convolution", "conv",
+             {"kernel": "(5,5)", "num_filter": "8", "stride": "(1,1)",
+              "no_bias": "False"},
+             [[0, 0, 0], [1, 0, 0], [2, 0, 0]]),                     # 3
+        node("null", "bn_gamma"),                                    # 4
+        node("null", "bn_beta"),                                     # 5
+        node("null", "bn_moving_mean"),                              # 6
+        node("null", "bn_moving_var"),                               # 7
+        node("BatchNorm", "bn",
+             {"eps": "0.001", "momentum": "0.9", "fix_gamma": "False"},
+             [[3, 0, 0], [4, 0, 0], [5, 0, 0], [6, 0, 0], [7, 0, 0]]),  # 8
+        node("Activation", "act", {"act_type": "tanh"}, [[8, 0, 0]]),  # 9
+        node("Pooling", "pool",
+             {"kernel": "(2,2)", "stride": "(2,2)", "pool_type": "max"},
+             [[9, 0, 0]]),                                           # 10
+        node("Flatten", "flat", None, [[10, 0, 0]]),                 # 11
+        node("null", "fc_weight"),                                   # 12
+        node("null", "fc_bias"),                                     # 13
+        node("FullyConnected", "fc", {"num_hidden": "10"},
+             [[11, 0, 0], [12, 0, 0], [13, 0, 0]]),                  # 14
+        node("null", "softmax_label"),                               # 15
+        node("SoftmaxOutput", "softmax", None,
+             [[14, 0, 0], [15, 0, 0]]),                              # 16
+    ]
+    graph = {
+        "nodes": nodes,
+        "arg_nodes": [i for i, n in enumerate(nodes) if n["op"] == "null"],
+        "node_row_ptr": list(range(len(nodes) + 1)),
+        "heads": [[16, 0, 0]],
+        "attrs": {"mxnet_version": ["int", 905]},
+    }
+    with open(os.path.join(HERE, "ref_lenet-symbol.json"), "w") as f:
+        json.dump(graph, f, indent=2)
+
+    # --- v0.8-style: aux inputs MISSING from BatchNorm, bare hidden
+    # keys, "head" instead of "heads" ---
+    nodes8 = [n.copy() for n in nodes]
+    del nodes8[6:8]  # drop bn_moving_mean / bn_moving_var variables
+
+    def shift(e):
+        return [e[0] - 2 if e[0] >= 8 else e[0], e[1], e[2]]
+
+    nodes8[6] = node("BatchNorm", "bn",
+                     {"eps": "0.001", "momentum": "0.9",
+                      "fix_gamma": "False", "lr_mult": "1.0"},
+                     [[3, 0, 0], [4, 0, 0], [5, 0, 0]])
+    for n in nodes8[7:]:
+        n["inputs"] = [shift(e) for e in n["inputs"]]
+    nodes8[1]["attr"] = {"lr_mult": "2.0"}   # bare hidden key form
+    graph8 = {
+        "nodes": nodes8,
+        "arg_nodes": [i for i, n in enumerate(nodes8) if n["op"] == "null"],
+        "head": [[14, 0, 0]],
+        "attrs": {"mxnet_version": ["int", 800]},
+    }
+    with open(os.path.join(HERE, "ref_lenet_v08-symbol.json"), "w") as f:
+        json.dump(graph8, f, indent=2)
+
+    # --- params blob (legacy layout) ---
+    params = [
+        ("arg:conv_weight", rng.randn(8, 1, 5, 5) * 0.2),
+        ("arg:conv_bias", rng.randn(8) * 0.1),
+        ("arg:bn_gamma", 1.0 + rng.randn(8) * 0.05),
+        ("arg:bn_beta", rng.randn(8) * 0.05),
+        ("arg:fc_weight", rng.randn(10, 8 * 12 * 12) * 0.1),
+        ("arg:fc_bias", rng.randn(10) * 0.1),
+        ("aux:bn_moving_mean", rng.randn(8) * 0.1),
+        ("aux:bn_moving_var", 1.0 + rng.rand(8) * 0.1),
+    ]
+    with open(os.path.join(HERE, "ref_lenet-0001.params"), "wb") as f:
+        f.write(pack_params([(n, np.asarray(a)) for n, a in params]))
+    print("wrote fixtures to", HERE)
+
+
+if __name__ == "__main__":
+    main()
