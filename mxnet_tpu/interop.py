@@ -267,7 +267,9 @@ def load_symbol_json(json_str):
                 child.is_aux = True
         # relocate arg-scoped hidden keys onto the named input variable
         # (UpgradeJSON_FixParsing's second branch); unmatched names fall
-        # back to the op node's misc under the original key
+        # back to the op node's misc under the original key (mutate
+        # node.misc_attrs, NOT the local dict: _Node replaces a falsy
+        # misc with a fresh one at construction)
         if arg_scoped:
             argn = list(op.get_arg_names(attrs)) if not op.variadic else []
             for aname, hid, v in arg_scoped:
@@ -275,7 +277,7 @@ def load_symbol_json(json_str):
                     node.inputs[argn.index(aname)][0].misc_attrs[
                         "__%s__" % hid] = v
                 else:
-                    misc["%s_%s" % (aname, hid)] = v
+                    node.misc_attrs["%s_%s" % (aname, hid)] = v
         nodes.append(node)
     heads = data.get("heads", data.get("head"))
     entries = [(nodes[e[0]], e[1]) for e in heads]

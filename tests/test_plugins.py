@@ -67,3 +67,152 @@ layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" top: "loss" }
     assert out[0].shape == (2, 3)
     np.testing.assert_allclose(out[0].asnumpy().sum(axis=1),
                                np.ones(2), rtol=1e-5)
+
+
+# --- caffemodel weight conversion (binary protobuf, no caffe dep) ---------
+
+def _vint(x):
+    out = b""
+    while True:
+        b7 = x & 0x7F
+        x >>= 7
+        if x:
+            out += bytes([b7 | 0x80])
+        else:
+            return out + bytes([b7])
+
+
+def _ld(field, payload):
+    return _vint((field << 3) | 2) + _vint(len(payload)) + payload
+
+
+def _blob(arr, legacy4=False):
+    arr = np.asarray(arr, np.float32)
+    if legacy4:
+        shp = (list(arr.shape) + [1, 1, 1, 1])[:4]
+        head = b"".join(_vint((f << 3) | 0) + _vint(d)
+                        for f, d in zip((1, 2, 3, 4), shp))
+    else:
+        dims = b"".join(_vint(d) for d in arr.shape)
+        head = _ld(7, _ld(1, dims))          # BlobShape packed dim
+    return head + _ld(5, arr.tobytes())      # packed float data
+
+
+def _layer(name, ltype, blobs, v1=False):
+    if v1:
+        enum = {"Convolution": 4, "InnerProduct": 14}[ltype]
+        body = (_ld(4, name.encode()) + _vint((5 << 3) | 0) + _vint(enum)
+                + b"".join(_ld(6, b) for b in blobs))
+        return _ld(2, body)
+    body = (_ld(1, name.encode()) + _ld(2, ltype.encode())
+            + b"".join(_ld(7, b) for b in blobs))
+    return _ld(100, body)
+
+
+def _load_converter(mod):
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "caffe_converter",
+        mod + ".py")
+    spec = importlib.util.spec_from_file_location(mod, path)
+    m = importlib.util.module_from_spec(spec)
+    import sys
+    sys.modules.setdefault(mod, m)  # convert_model imports caffe_parser
+    spec.loader.exec_module(m)
+    return m
+
+
+def test_caffemodel_weights_convert(tmp_path):
+    """Full weights conversion from a hand-encoded .caffemodel binary:
+    first-conv BGR->RGB swap, BatchNorm moving stats un-scaled by the
+    scale factor, Scale blobs landing on the bn's gamma/beta, IP
+    weights reshaped — then the converted checkpoint predicts."""
+    _load_converter("caffe_parser")
+    cm = _load_converter("convert_model")
+
+    proto = '''
+name: "Tiny"
+input: "data"
+input_dim: 2 input_dim: 3 input_dim: 8 input_dim: 8
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 } }
+layer { name: "bn1" type: "BatchNorm" bottom: "conv1" top: "conv1"
+  batch_norm_param { eps: 0.00002 } }
+layer { name: "scale1" type: "Scale" bottom: "conv1" top: "conv1" }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "ip" type: "InnerProduct" bottom: "conv1" top: "ip"
+  inner_product_param { num_output: 3 } }
+layer { name: "prob" type: "Softmax" bottom: "ip" top: "prob" }
+'''
+    rng = np.random.RandomState(0)
+    w_conv = rng.randn(4, 3, 3, 3).astype(np.float32)
+    b_conv = rng.randn(4).astype(np.float32)
+    bn_mean = rng.randn(4).astype(np.float32)
+    bn_var = rng.rand(4).astype(np.float32) + 0.5
+    sfactor = np.float32(2.0)                  # caffe stores UNnormalized
+    gamma = rng.rand(4).astype(np.float32) + 0.5
+    beta = rng.randn(4).astype(np.float32)
+    w_ip = rng.randn(3, 4 * 8 * 8).astype(np.float32)
+    b_ip = rng.randn(3).astype(np.float32)
+
+    model = b"".join([
+        _layer("conv1", "Convolution", [_blob(w_conv), _blob(b_conv)]),
+        _layer("bn1", "BatchNorm",
+               [_blob(bn_mean * sfactor), _blob(bn_var * sfactor),
+                _blob(np.array([sfactor]))]),
+        _layer("scale1", "Scale", [_blob(gamma), _blob(beta)]),
+        _layer("ip", "InnerProduct",
+               [_blob(w_ip, legacy4=True), _blob(b_ip, legacy4=True)]),
+    ])
+    pt = tmp_path / "tiny.prototxt"
+    cf = tmp_path / "tiny.caffemodel"
+    pt.write_text(proto)
+    cf.write_bytes(model)
+
+    prefix = str(tmp_path / "out")
+    sym, arg_params, aux_params, in_dim = cm.convert_model(
+        str(pt), str(cf), prefix)
+    assert in_dim == (2, 3, 8, 8)
+    # first conv channels swapped BGR->RGB
+    np.testing.assert_array_equal(arg_params["conv1_weight"].asnumpy(),
+                                  w_conv[:, [2, 1, 0]])
+    np.testing.assert_array_equal(arg_params["conv1_bias"].asnumpy(),
+                                  b_conv)
+    # bn stats divided back by the scale factor
+    np.testing.assert_allclose(aux_params["bn1_moving_mean"].asnumpy(),
+                               bn_mean, rtol=1e-6)
+    np.testing.assert_allclose(aux_params["bn1_moving_var"].asnumpy(),
+                               bn_var, rtol=1e-6)
+    # scale blobs land on the bn's gamma/beta
+    np.testing.assert_array_equal(arg_params["bn1_gamma"].asnumpy(), gamma)
+    np.testing.assert_array_equal(arg_params["bn1_beta"].asnumpy(), beta)
+    np.testing.assert_array_equal(
+        arg_params["ip_weight"].asnumpy(), w_ip)
+
+    # the written checkpoint loads through load_checkpoint and predicts
+    sym2, args2, aux2 = mx.model.load_checkpoint(prefix, 0)
+    exe = sym2.simple_bind(mx.cpu(), grad_req="null",
+                           data=(2, 3, 8, 8), softmax_label=(2,))
+    for k, v in args2.items():
+        exe.arg_dict[k][:] = v.asnumpy()
+    for k, v in aux2.items():
+        exe.aux_dict[k][:] = v.asnumpy()
+    exe.arg_dict["data"][:] = rng.randn(2, 3, 8, 8).astype(np.float32)
+    exe.forward(is_train=False)
+    out = exe.outputs[0].asnumpy()
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(2), rtol=1e-5)
+
+
+def test_caffemodel_v1_layer_format():
+    _load_converter("caffe_parser")
+    import caffe_parser as cp
+
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    blob_bytes = _blob(w)
+    layers = cp.read_caffemodel(_layer("old_ip", "InnerProduct",
+                                       [blob_bytes], v1=True))
+    assert layers[0]["name"] == "old_ip"
+    assert layers[0]["type"] == "InnerProduct"
+    np.testing.assert_array_equal(layers[0]["blobs"][0], w)

@@ -1,0 +1,144 @@
+"""Minimal protobuf *wire-format* reader for .caffemodel files — no
+caffe or protobuf dependency (the reference's caffe_parser.py imports
+pycaffe / compiled caffe_pb2; here the handful of NetParameter fields
+the converter needs are decoded straight from the wire encoding).
+
+Field numbers (public caffe.proto):
+  NetParameter: name=1, layers(V1)=2, input=3, input_dim=4, layer=100
+  LayerParameter:   name=1, type=2(string), blobs=7
+  V1LayerParameter: name=4, type=5(enum),  blobs=6
+  BlobProto: num=1 channels=2 height=3 width=4 (legacy 4D),
+             data=5 (packed float), shape=7 (BlobShape), double_data=9
+  BlobShape: dim=1 (packed int64)
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+# V1LayerType enum -> layer-name strings (upstream caffe.proto; V1
+# predates BatchNorm/Scale, so those only appear in the new format)
+V1_TYPE_NAMES = {1: "Accuracy", 3: "Concat", 4: "Convolution", 5: "Data",
+                 6: "Dropout", 8: "Flatten", 12: "ImageData",
+                 14: "InnerProduct", 15: "LRN", 17: "Pooling", 18: "ReLU",
+                 19: "Sigmoid", 20: "Softmax", 21: "SoftmaxWithLoss",
+                 23: "TanH", 25: "Eltwise", 39: "Deconvolution"}
+
+
+def _varint(buf, o):
+    x = 0
+    shift = 0
+    while True:
+        b = buf[o]
+        o += 1
+        x |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return x, o
+        shift += 7
+
+
+def walk(buf):
+    """Yield (field_number, wire_type, value) over one message's fields.
+    wire 0 -> int, 1 -> 8 raw bytes, 2 -> bytes, 5 -> 4 raw bytes."""
+    o = 0
+    n = len(buf)
+    while o < n:
+        key, o = _varint(buf, o)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, o = _varint(buf, o)
+        elif wire == 1:
+            v = buf[o:o + 8]
+            o += 8
+        elif wire == 2:
+            ln, o = _varint(buf, o)
+            v = buf[o:o + ln]
+            o += ln
+        elif wire == 5:
+            v = buf[o:o + 4]
+            o += 4
+        else:
+            raise ValueError("unsupported wire type %d (field %d)"
+                             % (wire, field))
+        yield field, wire, v
+
+
+def _packed(wire_payloads, scalar_fmt):
+    """Decode a repeated scalar field that may be packed (one
+    length-delimited payload) or unpacked (one 4/8-byte entry per
+    element)."""
+    out = []
+    for wire, v in wire_payloads:
+        out.append(np.frombuffer(bytes(v), dtype=scalar_fmt))
+    return (np.concatenate(out) if out
+            else np.zeros(0, np.dtype(scalar_fmt)))
+
+
+def _parse_blob(buf):
+    shape = None
+    legacy = {}
+    data_parts, ddata_parts = [], []
+    for field, wire, v in walk(buf):
+        if field == 7 and wire == 2:              # BlobShape
+            dims = []
+            for f2, w2, v2 in walk(v):
+                if f2 == 1:
+                    if w2 == 2:                   # packed int64 varints
+                        o = 0
+                        while o < len(v2):
+                            d, o = _varint(v2, o)
+                            dims.append(d)
+                    else:
+                        dims.append(v2)
+            shape = tuple(dims)
+        elif field == 5:                          # data (float)
+            data_parts.append((wire, v))
+        elif field == 9:                          # double_data
+            ddata_parts.append((wire, v))
+        elif field in (1, 2, 3, 4) and wire == 0:  # legacy num/c/h/w
+            legacy[field] = v
+    if ddata_parts:
+        data = _packed(ddata_parts, "<f8").astype(np.float32)
+    else:
+        data = np.asarray(_packed(data_parts, "<f4"))
+    if shape is None and legacy:
+        shape = tuple(legacy.get(k, 1) for k in (1, 2, 3, 4))
+    if shape is not None and int(np.prod(shape)) == data.size:
+        data = data.reshape(shape)
+    return data
+
+
+def _parse_layer(buf, v1):
+    name, ltype = "", ""
+    blobs = []
+    f_name, f_type, f_blobs = (4, 5, 6) if v1 else (1, 2, 7)
+    for field, wire, v in walk(buf):
+        if field == f_name and wire == 2:
+            name = v.decode()
+        elif field == f_type:
+            if v1:
+                ltype = V1_TYPE_NAMES.get(int(v), str(int(v)))
+            else:
+                ltype = v.decode()
+        elif field == f_blobs and wire == 2:
+            blobs.append(_parse_blob(v))
+    return {"name": name, "type": ltype, "blobs": blobs}
+
+
+def read_caffemodel(fname_or_bytes):
+    """Parse a .caffemodel binary NetParameter. Returns a list of
+    {"name", "type", "blobs": [np.ndarray, ...]} in file order (layers
+    without learned blobs included, blobs empty)."""
+    if isinstance(fname_or_bytes, bytes):
+        data = fname_or_bytes
+    else:
+        with open(fname_or_bytes, "rb") as f:
+            data = f.read()
+    layers = []
+    for field, wire, v in walk(data):
+        if field == 100 and wire == 2:            # LayerParameter
+            layers.append(_parse_layer(v, v1=False))
+        elif field == 2 and wire == 2:            # V1LayerParameter
+            layers.append(_parse_layer(v, v1=True))
+    return layers

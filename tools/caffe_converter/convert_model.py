@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Convert a trained Caffe model (.prototxt + .caffemodel) into a
+framework checkpoint — topology AND weights, no caffe dependency (the
+binary protobuf is decoded by caffe_parser.py).
+
+Mapping (the semantics of the reference's tools/caffe_converter/
+convert_model.py:49-160, re-expressed):
+- Convolution / InnerProduct / PReLU blobs -> <name>_weight/_bias
+  (/_gamma), reshaped to the inferred arg shapes; the FIRST conv's
+  input channels are swapped BGR->RGB when the net takes 3/4-channel
+  images (Caffe datasets are BGR).
+- BatchNorm blobs (mean, var, scale_factor) -> <name>_moving_mean/var
+  divided by the scale factor. Caffe's eps is set on the symbol at
+  conversion time (convert_symbol.py), so no variance correction term.
+- Scale blobs -> <bn_name>_gamma/_beta of the preceding BatchNorm
+  (layer named scale* pairs with bn*).
+
+    python tools/caffe_converter/convert_model.py deploy.prototxt \
+        net.caffemodel out_prefix
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import caffe_parser  # noqa: E402
+from convert_symbol import convert, input_dim  # noqa: E402
+
+
+def convert_model(prototxt_fname, caffemodel_fname, output_prefix=None):
+    """Returns (sym, arg_params, aux_params, input_dim)."""
+    import mxnet_tpu as mx
+
+    text = open(prototxt_fname).read()
+    sym, input_name = convert(text)
+    in_dim = input_dim(text)
+    arg_shapes, _, aux_shapes = sym.infer_shape(**{input_name: in_dim})
+    arg_shape_dic = dict(zip(sym.list_arguments(), arg_shapes))
+    aux_shape_dic = dict(zip(sym.list_auxiliary_states(), aux_shapes))
+
+    arg_params, aux_params = {}, {}
+    first_conv = True
+    for layer in caffe_parser.read_caffemodel(caffemodel_fname):
+        name, ltype, blobs = layer["name"], layer["type"], layer["blobs"]
+        if not blobs:
+            continue
+        if ltype in ("Convolution", "InnerProduct"):
+            wmat = np.asarray(blobs[0], np.float32)
+            wname = name + "_weight"
+            if wname not in arg_shape_dic:
+                print("skipping %s: %s not in symbol" % (name, wname))
+                continue
+            wmat = wmat.reshape(arg_shape_dic[wname])
+            if (first_conv and ltype == "Convolution"
+                    and wmat.shape[1] in (3, 4)):
+                wmat = wmat.copy()
+                wmat[:, [0, 2]] = wmat[:, [2, 0]]   # BGR -> RGB
+            arg_params[wname] = mx.nd.array(wmat)
+            if len(blobs) > 1:
+                bname = name + "_bias"
+                arg_params[bname] = mx.nd.array(
+                    np.asarray(blobs[1], np.float32).reshape(
+                        arg_shape_dic[bname]))
+            if ltype == "Convolution":
+                first_conv = False
+        elif ltype == "PReLU":
+            gname = name + "_gamma"
+            if gname not in arg_shape_dic:
+                print("skipping %s: %s not in symbol" % (name, gname))
+                continue
+            arg_params[gname] = mx.nd.array(
+                np.asarray(blobs[0], np.float32).reshape(
+                    arg_shape_dic[gname]))
+        elif ltype == "BatchNorm":
+            if ("%s_moving_mean" % name) not in aux_shape_dic:
+                print("skipping %s: not in symbol" % name)
+                continue
+            if len(blobs) < 3:
+                print("skipping %s: %d blobs (expected mean/var/scale)"
+                      % (name, len(blobs)))
+                continue
+            sf = float(np.asarray(blobs[2], np.float32).ravel()[0])
+            sf = 1.0 / sf if sf != 0 else 0.0
+            for key, blob in (("moving_mean", blobs[0]),
+                              ("moving_var", blobs[1])):
+                full = "%s_%s" % (name, key)
+                aux_params[full] = mx.nd.array(
+                    np.asarray(blob, np.float32).reshape(
+                        aux_shape_dic[full]) * sf)
+        elif ltype == "Scale":
+            bn_name = name.replace("scale", "bn")
+            for key, blob in (("gamma", blobs[0]), ("beta", blobs[1])):
+                full = "%s_%s" % (bn_name, key)
+                if full not in arg_shape_dic:
+                    print("skipping %s: %s not in symbol" % (name, full))
+                    break
+                arg_params[full] = mx.nd.array(
+                    np.asarray(blob, np.float32).reshape(
+                        arg_shape_dic[full]))
+        else:
+            print("skipping layer %s of type %s (%d blobs)"
+                  % (name, ltype, len(blobs)))
+
+    # BatchNorms with no Scale partner: identity gamma/beta
+    for aname, shp in arg_shape_dic.items():
+        if aname not in arg_params and aname != input_name:
+            if aname.endswith("_gamma"):
+                arg_params[aname] = mx.nd.array(np.ones(shp, np.float32))
+            elif aname.endswith("_beta"):
+                arg_params[aname] = mx.nd.array(np.zeros(shp, np.float32))
+
+    if output_prefix is not None:
+        sym.save(output_prefix + "-symbol.json")
+        payload = {"arg:%s" % k: v for k, v in arg_params.items()}
+        payload.update({"aux:%s" % k: v for k, v in aux_params.items()})
+        mx.nd.save(output_prefix + "-0000.params", payload)
+    return sym, arg_params, aux_params, in_dim
+
+
+def main():
+    if len(sys.argv) < 3:
+        print(__doc__)
+        sys.exit(1)
+    prefix = sys.argv[3] if len(sys.argv) > 3 else "converted"
+    sym, arg_params, aux_params, in_dim = convert_model(
+        sys.argv[1], sys.argv[2], prefix)
+    print("wrote %s-symbol.json / %s-0000.params (input %s, %d args, "
+          "%d aux)" % (prefix, prefix, in_dim, len(arg_params),
+                       len(aux_params)))
+
+
+if __name__ == "__main__":
+    main()

@@ -192,7 +192,14 @@ def convert(text):
         elif ltype == "FLATTEN":
             out = mx.sym.Flatten(bot[0], name=name)
         elif ltype == "BATCHNORM":
-            out = mx.sym.BatchNorm(bot[0], name=name)
+            p = l.get("batch_norm_param", {})
+            # Caffe BN has no gamma/beta (a Scale layer follows); set
+            # Caffe's eps directly (default 1e-5) — no variance
+            # eps-correction dance needed, unlike the reference's
+            # convert_model.py:144-150
+            out = mx.sym.BatchNorm(bot[0], fix_gamma=False,
+                                   eps=float(p.get("eps", 1e-5)),
+                                   use_global_stats=True, name=name)
         elif ltype == "SCALE":
             out = bot[0]  # folded into the preceding BatchNorm's gamma/beta
         elif ltype in ("SOFTMAX", "SOFTMAXWITHLOSS"):
@@ -205,6 +212,27 @@ def convert(text):
             blobs[t] = out
 
     return out, input_name
+
+
+def input_dim(text):
+    """The deploy-prototxt input shape: `input_dim:` repeated 4x,
+    `input_shape { dim: ... }`, or a data layer's shape block."""
+    net = parse_prototxt(text)
+    if "input_dim" in net:
+        dims = [int(d) for d in _as_list(net["input_dim"])]
+        # multi-input deploy files repeat input_dim per input (4 each);
+        # only the FIRST input is converted
+        return tuple(dims[:4]) if len(dims) > 4 else tuple(dims)
+    if "input_shape" in net:
+        shp = _as_list(net["input_shape"])[0]
+        return tuple(int(d) for d in _as_list(shp.get("dim")))
+    for l in _as_list(net.get("layer") or net.get("layers")):
+        if str(l.get("type", "")).upper() in ("INPUT", "DATA"):
+            ip = l.get("input_param", {})
+            if "shape" in ip:
+                shp = _as_list(ip["shape"])[0]
+                return tuple(int(d) for d in _as_list(shp.get("dim")))
+    raise ValueError("prototxt declares no input shape")
 
 
 def main():
