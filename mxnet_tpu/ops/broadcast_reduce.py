@@ -79,6 +79,25 @@ def _argmax_channel(attrs, data):
     return jnp.argmax(data, axis=1).astype(data.dtype)
 
 
+@defop("pick", arg_names=("data", "index"), no_grad_inputs=("index",),
+       param_spec={"axis": -1, "keepdims": False, "mode": "clip"})
+def _pick(attrs, data, index):
+    """Pick one element per (n-1)-dim index position along ``axis``;
+    out-of-range indices clip to the last element or wrap, per ``mode``
+    (reference broadcast_reduce_op_index.cc pick)."""
+    ax = attrs["axis"]
+    ax = data.ndim - 1 if ax is None else int(ax) % data.ndim
+    if attrs["mode"] == "wrap":
+        idx = jnp.mod(index.astype(jnp.int32), data.shape[ax])
+    else:
+        idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[ax] - 1)
+    # indices may come keepdims-shaped (size-1 at `axis`) or squeezed
+    if idx.ndim == data.ndim - 1:
+        idx = jnp.expand_dims(idx, ax)
+    out = jnp.take_along_axis(data, idx, axis=ax)
+    return out if attrs["keepdims"] else jnp.squeeze(out, ax)
+
+
 # --- broadcasting binary ops (reference elemwise_binary_broadcast_op*.cc) ---
 def _broadcast_binary(name, fn):
     defop(name, arg_names=("lhs", "rhs"), param_spec={})(

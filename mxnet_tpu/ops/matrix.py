@@ -71,7 +71,7 @@ def _infer_reshape(data_shape, target):
         if k == 0:
             out.append(src[i]); i += 1
         elif k == -1:
-            out.append(-1); i += 1  # placeholder; src advance fixed below
+            out.append(-1); i = min(i + 1, len(src))  # placeholder
         elif k == -2:
             out.extend(src[i:]); i = len(src)
         elif k == -3:
@@ -84,7 +84,10 @@ def _infer_reshape(data_shape, target):
                 b = src[i] // a
             out.extend([a, b]); i += 1; j += 2
         else:
-            out.append(int(k))
+            # an explicit dim consumes one source dim too (reference
+            # ReshapeInferShape ++src_idx on positive dims) — without
+            # this, a following -4/-3/0 splits the WRONG source dim
+            out.append(int(k)); i = min(i + 1, len(src))
         j += 1
     if -1 in out:
         known = int(np.prod([d for d in out if d != -1])) or 1
@@ -95,9 +98,15 @@ def _infer_reshape(data_shape, target):
 
 @defop("Reshape", arg_names=("data",), param_spec={"shape": (), "reverse": False, "target_shape": (), "keep_highest": False})
 def _reshape(attrs, data):
-    """Reshape with the reference's 0/-1/-2/-3/-4 codes (matrix_op.cc)."""
+    """Reshape with the reference's 0/-1/-2/-3/-4 codes (matrix_op.cc).
+    ``reverse=True`` matches the special codes from the RIGHT (reference
+    ReshapeInferShape reverses src dims and target, then un-reverses)."""
     shape = tuple(attrs["shape"]) if attrs["shape"] else tuple(attrs["target_shape"])
-    return jnp.reshape(data, _infer_reshape(data.shape, shape))
+    if attrs.get("reverse"):
+        inferred = _infer_reshape(data.shape[::-1], shape[::-1])[::-1]
+    else:
+        inferred = _infer_reshape(data.shape, shape)
+    return jnp.reshape(data, inferred)
 
 
 alias("Reshape", "reshape")

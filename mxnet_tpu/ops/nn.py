@@ -240,13 +240,28 @@ def _deconvolution(attrs, data, weight, bias=None):
     pad = _ntuple(attrs["pad"], n) if attrs["pad"] else (0,) * n
     adj = _ntuple(attrs["adj"], n) if attrs["adj"] else (0,) * n
     dilate = _ntuple(attrs["dilate"], n)
+    dk = tuple(dilate[i] * (kernel[i] - 1) + 1 for i in range(n))
     if attrs["target_shape"]:
+        # target_shape OVERRIDES pad and adj (reference
+        # deconvolution-inl.h InferPad: pad = ceil((total - target)/2),
+        # adj = (total - target) % 2, so the output lands exactly on
+        # target_shape; a user-supplied pad=(99,99)/adj is ignored)
         out_sp = tuple(int(s) for s in attrs["target_shape"])
+        diff = tuple((data.shape[2 + i] - 1) * stride[i] + dk[i] - out_sp[i]
+                     for i in range(n))
+        if any(d < 0 for d in diff):
+            raise ValueError(
+                "Deconvolution target_shape %s exceeds the maximum "
+                "reachable output %s for input %s"
+                % (out_sp, tuple((data.shape[2 + i] - 1) * stride[i]
+                                 + dk[i] for i in range(n)),
+                   data.shape[2:]))
+        pad = tuple(max(0, (d + 1) // 2) for d in diff)
     else:
         out_sp = tuple(
             (data.shape[2 + i] - 1) * stride[i]
             - 2 * pad[i]
-            + (dilate[i] * (kernel[i] - 1) + 1)
+            + dk[i]
             + adj[i]
             for i in range(n)
         )
@@ -264,6 +279,17 @@ def _deconvolution(attrs, data, weight, bias=None):
     def fwd_conv(y):
         return _conv_forward(conv_attrs, y, weight, None)
 
+    # The matching conv's output can exceed the deconv INPUT size when
+    # adj rows exist (odd target diff, or explicit adj at any stride):
+    # those trailing conv windows carry zero cotangent — pad `data` with
+    # trailing zeros so the vjp shapes line up for every reachable
+    # output, stride 1 included.
+    o_conv = tuple((out_sp[i] + 2 * pad[i] - dk[i]) // stride[i] + 1
+                   for i in range(n))
+    extra = tuple(o_conv[i] - data.shape[2 + i] for i in range(n))
+    if any(e > 0 for e in extra):
+        data = jnp.pad(data, ((0, 0), (0, 0))
+                       + tuple((0, max(0, e)) for e in extra))
     _, vjp = jax.vjp(fwd_conv, jnp.zeros(out_shape, data.dtype))
     (out,) = vjp(data)
     if bias is not None:

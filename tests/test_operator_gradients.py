@@ -168,6 +168,10 @@ _case("batch_take:", lambda: (sym.batch_take(V("a"), V("indices")),
                               {"a": _u((3, 4)),
                                "indices": np.array([1, 0, 3], np.float32)},
                               {"grad_nodes": ["a"]}))
+_case("pick:", lambda: (sym.pick(V("data"), V("index"), axis=1),
+                        {"data": _u((3, 4)),
+                         "index": np.array([0, 3, 1], np.float32)},
+                        {"grad_nodes": ["data"]}))
 _case("Embedding:", lambda: (sym.Embedding(V("data"), V("weight"), input_dim=5,
                                            output_dim=3),
                              {"data": np.array([[0, 2], [4, 1]], np.float32),

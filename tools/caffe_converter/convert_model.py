@@ -93,7 +93,12 @@ def convert_model(prototxt_fname, caffemodel_fname, output_prefix=None):
                         aux_shape_dic[full]) * sf)
         elif ltype == "Scale":
             bn_name = name.replace("scale", "bn")
-            for key, blob in (("gamma", blobs[0]), ("beta", blobs[1])):
+            # bias_term defaults to false in caffe.proto: a Scale layer
+            # may carry only gamma — beta then stays at the zero default
+            pairs = [("gamma", blobs[0])]
+            if len(blobs) > 1:
+                pairs.append(("beta", blobs[1]))
+            for key, blob in pairs:
                 full = "%s_%s" % (bn_name, key)
                 if full not in arg_shape_dic:
                     print("skipping %s: %s not in symbol" % (name, full))
