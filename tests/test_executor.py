@@ -219,3 +219,54 @@ def test_make_train_step_fused():
         np.testing.assert_allclose(np.asarray(params[n]),
                                    exe_ref.arg_dict[n].asnumpy(),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_make_train_step_chained_matches_sequential():
+    """chain=k runs k optimizer sub-steps in ONE device program
+    (lax.scan bulk execution, bench.py BENCH_CHAIN): 1 call at chain=4
+    must land on the same params as 4 calls at chain=1, including the
+    BatchNorm aux-state threading through the scan carry."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.BatchNorm(net, name="bn")    # aux state exercises the carry
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+
+    x = rng.uniform(-1, 1, (8, 4)).astype(np.float32)
+    lab = (rng.rand(8) > 0.5).astype(np.float32)
+    lr = 0.1
+
+    def sgd(params, grads, states):
+        return ({n: params[n] - lr * grads[n] for n in params}, states)
+
+    results = {}
+    for chain, calls in ((1, 4), (4, 1)):
+        exe = net.simple_bind(mx.cpu(), data=(8, 4), softmax_label=(8,))
+        init = mx.initializer.Xavier()
+        rs = np.random.RandomState(7)
+        for n, a in exe.arg_dict.items():
+            if n in ("data", "softmax_label"):
+                continue
+            a._data = jnp.asarray(
+                rs.uniform(-0.5, 0.5, a.shape).astype(np.float32))
+        step = exe.make_train_step(sgd, chain=chain)
+        pn = [n for n in exe.arg_dict if n not in ("data", "softmax_label")]
+        params = {n: jnp.array(exe.arg_dict[n]._data, copy=True)
+                  for n in pn}
+        feed = {"data": jnp.asarray(x), "softmax_label": jnp.asarray(lab)}
+        for _ in range(calls):
+            outs, params, _ = step(params, None, feed)
+        results[chain] = (params,
+                          {n: a.asnumpy() for n, a in exe.aux_dict.items()})
+    for n in results[1][0]:
+        np.testing.assert_allclose(
+            np.asarray(results[4][0][n]), np.asarray(results[1][0][n]),
+            rtol=2e-4, atol=2e-5, err_msg=n)
+    for n in results[1][1]:
+        np.testing.assert_allclose(results[4][1][n], results[1][1][n],
+                                   rtol=2e-4, atol=2e-5, err_msg="aux " + n)

@@ -23,7 +23,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def capture(batch, steps, logdir):
+def capture(batch, steps, logdir, chain=1):
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -56,7 +56,7 @@ def capture(batch, steps, logdir):
             nm[n] = m
         return np_, nm
 
-    step = exe.make_train_step(sgd_all)
+    step = exe.make_train_step(sgd_all, chain=chain)
     params = {n: jnp.array(exe.arg_dict[n]._data, copy=True) for n in pn}
     moms = {n: jnp.zeros_like(v) for n, v in params.items()}
     feed = {"data": x, "softmax_label": y}
@@ -70,7 +70,41 @@ def capture(batch, steps, logdir):
         np.asarray(jnp.reshape(outs[0], (-1,))[0])
 
 
-def report(logdir, steps):
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "bf16": 2, "f16": 2,
+                "s16": 2, "u16": 2, "f32": 4, "s32": 4, "u32": 4,
+                "f64": 8, "s64": 8, "u64": 8}
+
+
+def _hbm_split(hlo_name):
+    """Split an HLO instruction's operand/output bytes into (hbm_bytes,
+    onchip_bytes) by parsing the shapes out of the instruction text.
+
+    XLA's memory-space-assignment promotes hot operands into the chip's
+    alternate memory (the ``S(1)`` suffix inside the layout braces);
+    those reads never touch HBM, which is how a fusion's cost-analysis
+    ``bytes_accessed`` can imply > HBM-peak "bandwidth". Counting S(1)
+    operands separately is the reuse term that makes the table obey the
+    roofline."""
+    import re
+
+    hbm = onchip = 0
+    for m in re.finditer(r"(\w+)\[([\d,]*)\]\{([^}]*)\}", hlo_name):
+        dt, dims, layout = m.groups()
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        b = n * _DTYPE_BYTES[dt]
+        if "S(1)" in layout:
+            onchip += b
+        else:
+            hbm += b
+    return hbm, onchip
+
+
+def report(logdir):
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
     xs = sorted(glob.glob(logdir + "/**/*.xplane.pb", recursive=True))
@@ -101,34 +135,49 @@ def report(logdir, steps):
         for line in plane.lines:
             if line.name != "XLA Ops":
                 continue
+            # normalize PER OP from its own event count (an op inside a
+            # chained lax.scan executes steps*chain times, one outside
+            # only steps times — trusting a CLI step count skews both);
+            # ms values below are per EXECUTION of each op.
+            per = {}
+            for ev in line.events:
+                d, c = per.get(ev.metadata_id, (0.0, 0))
+                per[ev.metadata_id] = (d + ev.duration_ps / 1e9, c + 1)
             cat = collections.Counter()
             fl = collections.Counter()
             loops = collections.Counter()
             lbytes = {}
             total = 0.0
-            for ev in line.events:
-                m = md[ev.metadata_id]
+            for eid, (dur, cnt) in per.items():
+                m = md[eid]
                 c = m.get("cat", "uncategorized")
-                dur = ev.duration_ps / 1e9
-                cat[c] += dur
+                if c in ("while", "conditional"):
+                    # control-flow umbrella events envelop their whole
+                    # body: counting them double-counts every op inside
+                    continue
+                cat[c] += dur / cnt
                 fl[c] += m.get("flops", 0)
-                total += dur
+                total += dur / cnt
                 if c == "loop fusion":
                     # key by FULL name: truncated keys can collide and
                     # merge distinct fusions' durations
-                    loops[m["name"]] += dur
+                    loops[m["name"]] += dur / cnt
                     lbytes[m["name"]] = lbytes.get(m["name"], 0) \
                         + m.get("bytes", 0)
-            print("device total %.2f ms/step" % (total / steps))
+            print("device total %.2f ms/step" % total)
             for k, v in cat.most_common(12):
-                tf_s = (fl[k] / steps) / (v / steps * 1e-3) / 1e12 if v else 0
+                tf_s = fl[k] / (v * 1e-3) / 1e12 if v else 0
                 print("  %-32s %7.2f ms/step (%4.1f%%)  %6.1f TF/s"
-                      % (k, v / steps, 100 * v / total, tf_s))
-            print("top loop fusions (elementwise; achieved GB/s):")
-            for k, v in loops.most_common(8):
-                bw = (lbytes[k] / steps) / (v / steps * 1e-3) / 1e9 if v else 0
-                print("  %6.3f ms/step %5.0f GB/s  %s"
-                      % (v / steps, bw, k[:90]))
+                      % (k, v, 100 * v / total, tf_s))
+            print("top loop fusions (elementwise; HBM vs on-chip split):")
+            for k, ms in loops.most_common(8):
+                hbm_b, chip_b = _hbm_split(k)
+                hbm_bw = hbm_b / (ms * 1e-3) / 1e9 if ms else 0
+                raw_bw = lbytes[k] / (ms * 1e-3) / 1e9 if ms else 0
+                print("  %6.3f ms/step  HBM %5.0f GB/s (%5.1f MB)"
+                      "  on-chip %5.1f MB  [cost-analysis %4.0f GB/s]  %s"
+                      % (ms, hbm_bw, hbm_b / 1e6, chip_b / 1e6, raw_bw,
+                         k[:70]))
     _check_found(found)
 
 
@@ -145,10 +194,11 @@ def main():
     p.add_argument("--steps", type=int, default=5)
     p.add_argument("--logdir", default="/tmp/mxtpu_profile")
     p.add_argument("--report-only", action="store_true")
+    p.add_argument("--chain", type=int, default=1)
     args = p.parse_args()
     if not args.report_only:
-        capture(args.batch, args.steps, args.logdir)
-    report(args.logdir, args.steps)
+        capture(args.batch, args.steps, args.logdir, args.chain)
+    report(args.logdir)
 
 
 if __name__ == "__main__":
