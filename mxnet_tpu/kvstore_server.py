@@ -11,9 +11,16 @@ training step, plus the worker/server/scheduler process topology that
 
 Design (host-side, CPU — weights live on servers, as in the reference):
 
-- Transport: `multiprocessing.connection` (stdlib, pickle framing) instead
-  of ZeroMQ. One `Listener` per server; each worker holds one duplex
-  connection. `SArray` zero-copy becomes numpy buffers.
+- Transport: `multiprocessing.connection` (stdlib) instead of ZeroMQ.
+  One `Listener` per server; each worker holds one duplex connection.
+  Messages are framed as a small pickled CONTROL header followed by raw
+  length-prefixed tensor payloads (`send_bytes` / `recv_bytes_into`
+  straight into a preallocated numpy buffer) — the ps-lite `SArray`
+  zero-copy analogue. Tensor bytes never pass through pickle: no
+  serialize/copy on the hot push/pull path, and a tensor payload cannot
+  smuggle a pickle payload. Control messages (op names, keys,
+  set_optimizer's optimizer blob — the reference pickles that too) stay
+  pickled.
 - Server loop: connection-handler threads enqueue requests onto a single
   dispatch queue consumed by ONE thread — the reference's single-thread
   `Executor` run loop (kvstore_dist_server.h:28-85), which serializes all
@@ -47,6 +54,45 @@ from multiprocessing.connection import Client, Listener
 from .base import MXNetError
 
 _AUTH = b"mxnet_tpu_ps"
+# header marker for a tensor slot: replaced by (marker, dtype, shape) in
+# the pickled control header; the raw bytes follow as separate frames
+_ND = "__ndarray_frame__"
+
+
+def send_msg(conn, *parts):
+    """Frame a message: pickled control header (ndarray parts replaced
+    by (marker, dtype, shape) descriptors) + one raw frame per tensor."""
+    head, tensors = [], []
+    for p in parts:
+        if isinstance(p, np.ndarray):
+            t = np.ascontiguousarray(p)
+            head.append((_ND, str(t.dtype), t.shape))
+            tensors.append(t)
+        else:
+            head.append(p)
+    conn.send_bytes(pickle.dumps(tuple(head)))
+    for t in tensors:
+        # empty multi-dim arrays can't be memoryview-cast (zeros in
+        # shape); recv_msg special-cases size==0 symmetrically
+        conn.send_bytes(memoryview(t).cast("B") if t.size else b"")
+
+
+def recv_msg(conn):
+    """Inverse of send_msg: tensor frames land via recv_bytes_into in
+    freshly allocated numpy buffers — no pickle on tensor bytes."""
+    head = pickle.loads(conn.recv_bytes())
+    out = []
+    for p in head:
+        if isinstance(p, tuple) and len(p) == 3 and p[0] == _ND:
+            buf = np.empty(p[2], dtype=np.dtype(p[1]))
+            if buf.size:
+                conn.recv_bytes_into(memoryview(buf).cast("B"))
+            else:
+                conn.recv_bytes()
+            out.append(buf)
+        else:
+            out.append(p)
+    return tuple(out)
 
 
 def _uris():
@@ -131,7 +177,7 @@ class KVStoreServer:
             key, val = req[1], req[2]
             if key not in self.store:  # first init wins (rank-0 semantics)
                 self.store[key] = np.array(val, copy=True)
-            conn.send(("ok",))
+            send_msg(conn, "ok")
         elif op == "push":
             key, val = req[1], req[2]
             if self.sync_mode:
@@ -145,44 +191,52 @@ class KVStoreServer:
                     self._apply(key, self._merge.pop(key))
                     self._merge_count[key] = 0
                     for c in self._waiting.pop(key):
-                        c.send(("ok",))
+                        # one dead worker's connection must not abort
+                        # the replies to the LIVE waiters
+                        try:
+                            send_msg(c, "ok")
+                        except (OSError, EOFError, BrokenPipeError):
+                            pass
             else:
                 self._apply(key, val)
-                conn.send(("ok",))
+                send_msg(conn, "ok")
         elif op == "pull":
             key = req[1]
             if key not in self.store:
-                conn.send(("err", "pull of uninitialized key %r" % (key,)))
+                send_msg(conn, "err", "pull of uninitialized key %r" % (key,))
             else:
-                conn.send(("ok", self.store[key]))
+                send_msg(conn, "ok", self.store[key])
         elif op == "set_optimizer":
             from . import optimizer as opt
 
             optimizer = pickle.loads(req[1])
             self.updater = _NumpyUpdater(optimizer)
-            conn.send(("ok",))
+            send_msg(conn, "ok")
         elif op == "set_sync":
             # rank-0 worker announces consistency mode (kvstore.cc:31-38
             # kSyncMode command)
             self.sync_mode = bool(req[1])
-            conn.send(("ok",))
+            send_msg(conn, "ok")
         elif op == "barrier":
             self._barrier_conns.append(conn)
             if len(self._barrier_conns) == self.n_workers:
                 for c in self._barrier_conns:
-                    c.send(("ok",))
+                    try:
+                        send_msg(c, "ok")
+                    except (OSError, EOFError, BrokenPipeError):
+                        pass
                 self._barrier_conns = []
         elif op == "stop":
-            conn.send(("ok",))
+            send_msg(conn, "ok")
             self._stop.set()
         else:
-            conn.send(("err", "unknown op %r" % (op,)))
+            send_msg(conn, "err", "unknown op %r" % (op,))
 
     # --- threads ----------------------------------------------------------
     def _reader(self, conn):
         try:
             while not self._stop.is_set():
-                req = conn.recv()
+                req = recv_msg(conn)
                 self._q.put((conn, req))
         except (EOFError, OSError):
             pass
@@ -308,8 +362,8 @@ class PSClient:
     def _rpc(self, sid, *req):
         with self._locks[sid]:
             conn = self._ensure_conn(sid)
-            conn.send(req)
-            resp = conn.recv()
+            send_msg(conn, *req)
+            resp = recv_msg(conn)
         return self._check(resp)
 
     def _sharded_rpc(self, reqs):
@@ -323,8 +377,8 @@ class PSClient:
         try:
             conns = {sid: self._ensure_conn(sid) for sid in sids}
             for sid, req in reqs:
-                conns[sid].send(req)
-            resps = [conns[sid].recv() for sid, _ in reqs]
+                send_msg(conns[sid], *req)
+            resps = [recv_msg(conns[sid]) for sid, _ in reqs]
         finally:
             for sid in sorted(sids, reverse=True):
                 self._locks[sid].release()

@@ -118,3 +118,33 @@ def test_ps_kvstore_worker_facade(monkeypatch):
     kv.pull(9, out=out)
     np.testing.assert_allclose(out.asnumpy(), np.ones((2, 2)), rtol=1e-6)
     kv.stop_server()
+
+
+def test_wire_framing_roundtrip_edge_shapes():
+    """send_msg/recv_msg over a real pipe: empty multi-dim tensors,
+    mixed control+tensor messages, dtype preservation — the raw-frame
+    protocol must stay in sync across consecutive messages."""
+    import numpy as np
+    from multiprocessing import Pipe
+    from mxnet_tpu import kvstore_server as ps
+
+    a, b = Pipe()
+    cases = [
+        ("push", "k", np.arange(6, dtype=np.float32).reshape(2, 3)),
+        ("ok", np.zeros((0, 3), np.float32)),        # empty 2-D
+        ("ok", np.zeros((0,), np.int32)),            # empty 1-D
+        ("mixed", np.float32(0).reshape(()) * 0 + np.zeros((), np.float32),
+         "tail", np.arange(4, dtype=np.int64)),      # scalar + second nd
+        ("ctl-only", 42, {"nested": [1, 2]}),
+    ]
+    for msg in cases:
+        ps.send_msg(a, *msg)
+    for msg in cases:
+        got = ps.recv_msg(b)
+        assert len(got) == len(msg)
+        for want, g in zip(msg, got):
+            if isinstance(want, np.ndarray):
+                assert g.dtype == want.dtype and g.shape == want.shape
+                np.testing.assert_array_equal(g, want)
+            else:
+                assert g == want

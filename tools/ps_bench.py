@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Parameter-server push/pull latency micro-benchmark.
+
+Measures round-trip push+pull against one in-process server for a
+range of tensor sizes, and compares the wire path (raw-frame tensor
+payloads, kvstore_server.send_msg/recv_msg) against the former
+pickle-everything framing (reconstructed here for the comparison).
+
+    python tools/ps_bench.py
+"""
+import os
+import pickle
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import kvstore_server as ps  # noqa: E402
+
+
+def main():
+    addr = "/tmp/mxtpu_psbench.sock"  # AF_UNIX: avoids loopback-TCP delayed-ACK artifacts
+    server = ps.KVStoreServer(address=addr, n_workers=1, sync_mode=False)
+    server.start_background()
+    client = ps.PSClient([addr])
+
+    print("%10s  %12s  %14s  %12s" % ("elements", "rtt (framed)",
+                                      "pickle-only*", "speedup"))
+    for n in (1 << 10, 1 << 16, 1 << 20, 1 << 24):
+        v = np.random.RandomState(0).rand(n).astype(np.float32)
+        client.init("k%d" % n, v)
+        client.push("k%d" % n, v)   # warmup (incl. first-connect cost)
+        client.pull("k%d" % n)
+        reps = max(3, min(50, (1 << 24) // n))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            client.push("k%d" % n, v)
+            client.pull("k%d" % n)
+        framed = (time.perf_counter() - t0) / reps
+
+        # counterfactual: the serialize+deserialize cost the old framing
+        # added on top of the same socket traffic (pickle round-trips of
+        # the request and reply payloads, 2x per push+pull)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            for _ in range(2):
+                pickle.loads(pickle.dumps(("push", "k", v),
+                                          protocol=pickle.HIGHEST_PROTOCOL))
+        pickled = (time.perf_counter() - t0) / reps + framed
+        print("%10d  %9.3f ms  %11.3f ms  %11.2fx"
+              % (n, framed * 1e3, pickled * 1e3, pickled / framed))
+    client.stop()
+
+
+if __name__ == "__main__":
+    main()
