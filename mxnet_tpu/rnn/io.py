@@ -42,7 +42,12 @@ class BucketSentenceIter(DataIter):
     BucketingModule."""
 
     def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
-                 data_name="data", label_name="softmax_label", dtype="float32"):
+                 data_name="data", label_name="softmax_label", dtype="float32",
+                 sequence_labels=None):
+        """``sequence_labels``: optional per-SENTENCE scalar labels
+        (classification over variable-length text, e.g. the text-CNN
+        example). Default None keeps the language-model convention
+        (label = the sentence shifted left by one)."""
         super().__init__()
         if not buckets:
             buckets = [
@@ -52,7 +57,9 @@ class BucketSentenceIter(DataIter):
         buckets.sort()
         ndiscard = 0
         self.data = [[] for _ in buckets]
-        for sent in sentences:
+        self._seq_labels = ([[] for _ in buckets]
+                            if sequence_labels is not None else None)
+        for si, sent in enumerate(sentences):
             buck = bisect.bisect_left(buckets, len(sent))
             if buck == len(buckets):
                 ndiscard += 1
@@ -60,7 +67,12 @@ class BucketSentenceIter(DataIter):
             buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
             buff[: len(sent)] = sent
             self.data[buck].append(buff)
+            if self._seq_labels is not None:
+                self._seq_labels[buck].append(sequence_labels[si])
         self.data = [np.asarray(i, dtype=dtype) for i in self.data]
+        if self._seq_labels is not None:
+            self._seq_labels = [np.asarray(i, dtype=dtype)
+                                for i in self._seq_labels]
         if ndiscard:
             print("WARNING: discarded %d sentences longer than the largest bucket." % ndiscard)
 
@@ -76,7 +88,9 @@ class BucketSentenceIter(DataIter):
         self.default_bucket_key = max(buckets)
 
         self.provide_data = [DataDesc(data_name, (batch_size, self.default_bucket_key))]
-        self.provide_label = [DataDesc(label_name, (batch_size, self.default_bucket_key))]
+        self.provide_label = [DataDesc(
+            label_name, (batch_size,) if self._seq_labels is not None
+            else (batch_size, self.default_bucket_key))]
 
         self.idx = []
         for i, buck in enumerate(self.data):
@@ -87,14 +101,25 @@ class BucketSentenceIter(DataIter):
     def reset(self):
         self.curr_idx = 0
         random.shuffle(self.idx)
-        for buck in self.data:
-            np.random.shuffle(buck)
+        if self._seq_labels is None:
+            for buck in self.data:
+                np.random.shuffle(buck)
+        # (sequence-labels mode shuffles data and labels with one
+        # permutation below instead)
         self.nddata = []
         self.ndlabel = []
-        for buck in self.data:
-            label = np.empty_like(buck)
-            label[:, :-1] = buck[:, 1:]
-            label[:, -1] = self.invalid_label
+        for bi, buck in enumerate(self.data):
+            if self._seq_labels is not None:
+                # shuffle data and per-sentence labels with ONE perm
+                perm = np.random.permutation(len(buck)) if len(buck) else []
+                buck = buck[perm]
+                self.data[bi] = buck
+                self._seq_labels[bi] = self._seq_labels[bi][perm]
+                label = self._seq_labels[bi]
+            else:
+                label = np.empty_like(buck)
+                label[:, :-1] = buck[:, 1:]
+                label[:, -1] = self.invalid_label
             self.nddata.append(nd.array(buck, dtype=self.dtype))
             self.ndlabel.append(nd.array(label, dtype=self.dtype))
 

@@ -663,6 +663,11 @@ class BidirectionalCell(BaseRNNCell):
                           name="%st%d" % (self._output_prefix, i))
             for i, (l_o, r_o) in enumerate(zip(l_outputs, reversed(r_outputs)))
         ]
+        if merge_outputs:
+            # (N, T, 2H) stacking, same convention as BaseRNNCell.unroll
+            axis = layout.find("T")
+            outputs = [symbol.expand_dims(o, axis=axis) for o in outputs]
+            outputs = symbol.Concat(*outputs, dim=axis)
         states = l_states + r_states
         return outputs, states
 

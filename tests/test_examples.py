@@ -121,3 +121,34 @@ def test_transformer_bench_example():
                "--num-layers", "1", "--model-dim", "256", "--num-heads", "2",
                "--seq-len", "256", "--batch-size", "2", "--steps", "2")
     assert "micro" in out and "flash-vs-plain" in out
+
+
+def test_neural_style_example():
+    """Pretrained-model surgery (get_internals feature taps, frozen
+    weights, grad only on the image) + imperative-autograd TV term."""
+    out = _run("examples/neural-style/neural_style.py",
+               "--steps", "15", "--size", "32")
+    assert "neural-style OK" in out
+
+
+def test_cnn_text_classification_example():
+    """BucketingModule on a NON-RNN graph (Kim-CNN over bucketed
+    sentence lengths) + per-sentence labels in BucketSentenceIter."""
+    out = _run("examples/cnn-text-classification/text_cnn.py",
+               "--epochs", "3")
+    assert "text-cnn OK" in out
+
+
+def test_reinforce_example():
+    """Fully imperative RL loop: attach_grad weights, record/backward on
+    a REINFORCE surrogate over variable-length episodes."""
+    out = _run("examples/reinforcement-learning/reinforce_gridworld.py",
+               "--episodes", "120")
+    assert "reinforce OK" in out
+
+
+def test_bi_lstm_sort_example():
+    """BidirectionalCell.unroll(merge_outputs=True) end-to-end on the
+    sorting transduction a unidirectional model cannot learn."""
+    out = _run("examples/bi-lstm-sort/sort_io.py", "--epochs", "5")
+    assert "bi-lstm-sort OK" in out
