@@ -204,7 +204,8 @@ def gqa(args):
               % (tbn * 1e3, tbr * 1e3, tbr / tbn, tbe * 1e3, tbe / tbn))
 
 
-def _lm_symbol(vocab, num_layers, num_heads, dm, dff, use_flash):
+def _lm_symbol(vocab, num_layers, num_heads, dm, dff, use_flash,
+               num_kv_heads=0):
     """Decoder-only LM (models/transformer blocks, use_flash switchable)
     with a SCALAR loss head — on tunneled devices a (batch*seq, vocab)
     probability output costs a per-step fresh-buffer round trip that has
@@ -221,14 +222,18 @@ def _lm_symbol(vocab, num_layers, num_heads, dm, dff, use_flash):
         ln1_b = sym.Variable(name + "_ln1_beta", shape=(dm,))
         h = sym.LayerNorm(data=x, gamma=ln1_g, beta=ln1_b,
                           name=name + "_ln1")
+        # GQA: k/v projections shrink to num_kv_heads*head_dim and the
+        # flash kernel streams them narrow (ops/attention.py)
+        dkv = dm if not num_kv_heads else dm // num_heads * num_kv_heads
         q = sym.FullyConnected(data=h, num_hidden=dm, flatten=False,
                                no_bias=True, name=name + "_q")
-        k = sym.FullyConnected(data=h, num_hidden=dm, flatten=False,
+        k = sym.FullyConnected(data=h, num_hidden=dkv, flatten=False,
                                no_bias=True, name=name + "_k")
-        v = sym.FullyConnected(data=h, num_hidden=dm, flatten=False,
+        v = sym.FullyConnected(data=h, num_hidden=dkv, flatten=False,
                                no_bias=True, name=name + "_v")
         a = sym.MultiHeadAttention(query=q, key=k, value=v,
-                                   num_heads=num_heads, causal=True,
+                                   num_heads=num_heads,
+                                   num_kv_heads=num_kv_heads, causal=True,
                                    use_rope=True, use_flash=use_flash,
                                    name=name + "_attn")
         a = sym.FullyConnected(data=a, num_hidden=dm, flatten=False,
@@ -257,14 +262,34 @@ def _lm_symbol(vocab, num_layers, num_heads, dm, dff, use_flash):
     return sym.MakeLoss(nll, name="loss")
 
 
-def lm_train(args, use_flash):
+def lm_train(args, use_flash, num_kv_heads=0, remat=False, steps=None,
+             quiet=False):
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+
+    N, T = args.batch_size, args.seq_len
+    _remat_set_here = remat and not os.environ.get("MXNET_BACKWARD_DO_MIRROR")
+    if _remat_set_here:
+        os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
+    try:
+        return _lm_train_inner(args, use_flash, num_kv_heads, steps, quiet)
+    finally:
+        # never strip a USER-set env var, and never leak ours past an
+        # OOM (same contract as bench.py run_config)
+        if _remat_set_here:
+            os.environ.pop("MXNET_BACKWARD_DO_MIRROR", None)
+
+
+def _lm_train_inner(args, use_flash, num_kv_heads, steps, quiet):
     import numpy as np
     import jax
     import mxnet_tpu as mx
 
     N, T = args.batch_size, args.seq_len
     sym = _lm_symbol(args.vocab, args.num_layers, args.num_heads,
-                     args.model_dim, 4 * args.model_dim, use_flash)
+                     args.model_dim, 4 * args.model_dim, use_flash,
+                     num_kv_heads=num_kv_heads)
     dev = (mx.Context("tpu", 0) if jax.default_backend() != "cpu"
            else mx.cpu())
     mod = mx.mod.Module(sym, context=dev,
@@ -287,18 +312,60 @@ def lm_train(args, use_flash):
         mod.fit_step(batch)
     sync()
     times = []
+    nsteps = steps or args.steps
     for _ in range(3):
         t0 = time.perf_counter()
-        for _ in range(args.steps):
+        for _ in range(nsteps):
             mod.fit_step(batch)
         sync()
-        times.append((time.perf_counter() - t0) / args.steps)
+        times.append((time.perf_counter() - t0) / nsteps)
     t = sorted(times)[len(times) // 2]
-    print("transformer-lm(flash=%s) L=%d dm=%d heads=%d vocab=%d bs=%d "
-          "seq=%d: %.2f ms/step  %.0f tokens/s"
-          % (use_flash, args.num_layers, args.model_dim, args.num_heads,
-             args.vocab, N, T, t * 1e3, N * T / t))
-    return t
+    # sample memory stats while the module's buffers are LIVE (callers
+    # reading stats after return would see the post-free residual)
+    mem = {}
+    try:
+        mem = jax.devices()[0].memory_stats() or {}
+    except Exception:
+        pass
+    if not quiet:
+        print("transformer-lm(flash=%s) L=%d dm=%d heads=%d vocab=%d bs=%d "
+              "seq=%d: %.2f ms/step  %.0f tokens/s"
+              % (use_flash, args.num_layers, args.model_dim, args.num_heads,
+                 args.vocab, N, T, t * 1e3, N * T / t))
+    return t, mem
+
+
+def long_context(args):
+    """Single-chip long-context training headline (SURVEY §5.7: remat +
+    flash backward + narrow-kv GQA replace bucketing at scale): LM
+    training tokens/s at seq 16k/32k, bs 1, with HBM headroom from the
+    device memory stats."""
+    import jax
+
+    rows = []
+    cfgs = ((16384, 2, True), (32768, 2, True))
+    if os.environ.get("BENCH_LONG_SEQS"):  # CPU smoke / custom sweeps
+        cfgs = tuple((int(s), 2, True) for s in
+                     os.environ["BENCH_LONG_SEQS"].split(","))
+    for seq, kv_heads, remat in cfgs:
+        args.seq_len = seq
+        args.batch_size = 1
+        try:
+            t, stats = lm_train(args, use_flash=True,
+                                num_kv_heads=kv_heads, remat=remat,
+                                steps=5, quiet=True)
+        except Exception as e:
+            print("long-context seq=%d FAILED: %s: %s"
+                  % (seq, type(e).__name__, str(e)[:120]))
+            continue
+        used = stats.get("peak_bytes_in_use",
+                         stats.get("bytes_in_use", 0)) / 1e9
+        limit = stats.get("bytes_limit", 0) / 1e9
+        rows.append((seq, 1 * seq / t, t * 1e3, used, limit))
+        print("long-context seq=%d (bs1, remat, GQA hkv=%d): %.1f ms/step"
+              "  %.0f tokens/s  HBM %.2f/%.2f GB"
+              % (seq, kv_heads, t * 1e3, seq / t, used, limit))
+    return rows
 
 
 def main():
@@ -318,15 +385,20 @@ def main():
     p.add_argument("--skip-train", action="store_true")
     p.add_argument("--gqa", action="store_true",
                    help="run ONLY the grouped-query attention micro")
+    p.add_argument("--long", action="store_true",
+                   help="run ONLY the long-context 16k/32k LM headline")
     args = p.parse_args()
     if args.gqa:
         gqa(args)
         return
+    if args.long:
+        long_context(args)
+        return
     if not args.skip_micro:
         micro(args)
     if not args.skip_train:
-        t_flash = lm_train(args, use_flash=True)
-        t_plain = lm_train(args, use_flash=False)
+        t_flash, _ = lm_train(args, use_flash=True)
+        t_plain, _ = lm_train(args, use_flash=False)
         print("flash-vs-plain in training: %.2fx" % (t_plain / t_flash))
 
 
