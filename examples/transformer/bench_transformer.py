@@ -264,11 +264,6 @@ def _lm_symbol(vocab, num_layers, num_heads, dm, dff, use_flash,
 
 def lm_train(args, use_flash, num_kv_heads=0, remat=False, steps=None,
              quiet=False):
-    import numpy as np
-    import jax
-    import mxnet_tpu as mx
-
-    N, T = args.batch_size, args.seq_len
     _remat_set_here = remat and not os.environ.get("MXNET_BACKWARD_DO_MIRROR")
     if _remat_set_here:
         os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"

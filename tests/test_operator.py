@@ -954,3 +954,208 @@ def test_crop_center_and_offset():
                   offset=(1, 2))
     check_symbolic_forward(c2, {"data": x}, [x[:, :, 1:4, 2:7]],
                            rtol=1e-6)
+
+
+# --- tranche 2: heads, norms, sequence ops, samplers (reference
+# test_operator.py test_regression/test_instance_normalization/
+# test_l2_normalization/test_sequence_*/test_nearest_upsampling/
+# test_grid_generator/test_bilinear_sampler/test_svm re-expressed) -----
+
+
+def test_regression_heads_backward_semantics():
+    """Regression heads: forward is activation(pred); BACKWARD injects
+    (out - label) regardless of the activation's own derivative —
+    the reference regression_output-inl.h contract."""
+    rng = np.random.RandomState(30)
+    x = rng.randn(4, 3).astype(np.float32)
+    y = rng.randn(4, 3).astype(np.float32)
+    og = np.ones((4, 3), np.float32)
+    cases = [
+        ("LinearRegressionOutput", lambda v: v, lambda o, t: o - t),
+        ("LogisticRegressionOutput", lambda v: 1 / (1 + np.exp(-v)),
+         lambda o, t: o - t),
+        ("MAERegressionOutput", lambda v: v, lambda o, t: np.sign(o - t)),
+    ]
+    for name, fwd, bwd in cases:
+        s = getattr(sym, name)(sym.Variable("data"), sym.Variable("label"))
+        out = fwd(x)
+        check_symbolic_forward(s, {"data": x, "label": y}, [out],
+                               rtol=1e-5)
+        # reference regression_output-inl.h:76: grad = grad_scale /
+        # num_output * BackwardOp(out, label) — num_output = per-sample
+        # output count; label gets no gradient
+        check_symbolic_backward(s, {"data": x, "label": y}, [og],
+                                {"data": bwd(out, y) / x.shape[1]},
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_instance_norm_matches_numpy():
+    rng = np.random.RandomState(31)
+    x = rng.randn(2, 3, 4, 5).astype(np.float32)
+    g = rng.rand(3).astype(np.float32) + 0.5
+    b = rng.randn(3).astype(np.float32)
+    eps = 1e-3
+    s = sym.InstanceNorm(sym.Variable("data"), sym.Variable("gamma"),
+                         sym.Variable("beta"), eps=eps)
+    mean = x.mean(axis=(2, 3), keepdims=True)
+    var = x.var(axis=(2, 3), keepdims=True)
+    want = (x - mean) / np.sqrt(var + eps) * g[None, :, None, None] \
+        + b[None, :, None, None]
+    check_symbolic_forward(s, {"data": x, "gamma": g, "beta": b}, [want],
+                           rtol=1e-4, atol=1e-5)
+    check_numeric_gradient(s, {"data": x, "gamma": g, "beta": b},
+                           numeric_eps=1e-2, rtol=0.08, atol=2e-2)
+
+
+def test_l2_normalization_modes():
+    rng = np.random.RandomState(32)
+    x = rng.randn(2, 3, 4, 5).astype(np.float32)
+    eps = 1e-10
+    for mode, axes in (("instance", (1, 2, 3)), ("channel", (1,)),
+                       ("spatial", (2, 3))):
+        s = sym.L2Normalization(sym.Variable("data"), mode=mode, eps=eps)
+        norm = np.sqrt((x * x).sum(axis=axes, keepdims=True) + eps)
+        check_symbolic_forward(s, {"data": x}, [x / norm], rtol=1e-4,
+                               atol=1e-5)
+    check_numeric_gradient(
+        sym.L2Normalization(sym.Variable("data"), mode="channel"),
+        {"data": x}, numeric_eps=1e-2, rtol=0.08, atol=2e-2)
+
+
+def test_sequence_ops_axis_and_lengths():
+    """SequenceMask/Last/Reverse with use_sequence_length at ragged
+    lengths (reference test_sequence_mask + sequence_last)."""
+    rng = np.random.RandomState(33)
+    # (T, B, D) time-major, the reference layout
+    x = rng.randn(5, 3, 2).astype(np.float32)
+    lens = np.array([5, 2, 3], np.float32)
+    m = sym.SequenceMask(sym.Variable("data"), sym.Variable("seqlen"),
+                         use_sequence_length=True, value=-7.0)
+    want = x.copy()
+    for b, ln in enumerate(lens.astype(int)):
+        want[ln:, b] = -7.0
+    check_symbolic_forward(m, {"data": x, "seqlen": lens}, [want],
+                           rtol=1e-6)
+    last = sym.SequenceLast(sym.Variable("data"), sym.Variable("seqlen"),
+                            use_sequence_length=True)
+    want_last = np.stack([x[int(ln) - 1, b]
+                          for b, ln in enumerate(lens)], axis=0)
+    check_symbolic_forward(last, {"data": x, "seqlen": lens}, [want_last],
+                           rtol=1e-6)
+    rev = sym.SequenceReverse(sym.Variable("data"), sym.Variable("seqlen"),
+                              use_sequence_length=True)
+    want_rev = x.copy()
+    for b, ln in enumerate(lens.astype(int)):
+        want_rev[:ln, b] = x[:ln, b][::-1]
+    check_symbolic_forward(rev, {"data": x, "seqlen": lens}, [want_rev],
+                           rtol=1e-6)
+    # gradient of mask: 1 inside the sequence, 0 in the masked tail
+    og = np.ones_like(x)
+    want_g = np.zeros_like(x)
+    for b, ln in enumerate(lens.astype(int)):
+        want_g[:ln, b] = 1.0
+    check_symbolic_backward(m, {"data": x, "seqlen": lens}, [og],
+                            {"data": want_g}, rtol=1e-6)
+
+
+def test_nearest_upsampling_fwd_bwd():
+    rng = np.random.RandomState(34)
+    for scale in (2, 3):
+        x = rng.randn(1, 2, 3, 3).astype(np.float32)
+        s = sym.UpSampling(sym.Variable("d0"), sample_type="nearest",
+                           scale=scale, num_args=1)
+        want = x.repeat(scale, axis=2).repeat(scale, axis=3)
+        check_symbolic_forward(s, {"d0": x}, [want], rtol=1e-6)
+        # backward: each input cell accumulates its scale^2 outputs
+        og = rng.randn(*want.shape).astype(np.float32)
+        want_g = og.reshape(1, 2, 3, scale, 3, scale).sum(axis=(3, 5))
+        check_symbolic_backward(s, {"d0": x}, [og], {"d0": want_g},
+                                rtol=1e-5)
+
+
+def test_grid_generator_affine_identity_and_warp():
+    # identity affine -> the regular [-1, 1] grid
+    ident = np.array([[1, 0, 0, 0, 1, 0]], np.float32)
+    g = sym.GridGenerator(sym.Variable("affine"), transform_type="affine",
+                          target_shape=(3, 4))
+    _, out_shapes, _ = g.infer_shape(affine=(1, 6))
+    assert out_shapes[0] == (1, 2, 3, 4)
+    exe = g.simple_bind(mx.cpu(), grad_req="null", affine=(1, 6))
+    exe.arg_dict["affine"][:] = ident
+    out = exe.forward(is_train=False)[0].asnumpy()
+    xs = np.linspace(-1, 1, 4)
+    ys = np.linspace(-1, 1, 3)
+    np.testing.assert_allclose(out[0, 0], np.tile(xs, (3, 1)), atol=1e-5)
+    np.testing.assert_allclose(out[0, 1], np.tile(ys[:, None], (1, 4)),
+                               atol=1e-5)
+
+
+def test_bilinear_sampler_identity_grid():
+    """Sampling with the identity grid reproduces the input (interior
+    exactness — the reference test_bilinear_sampler's base case)."""
+    rng = np.random.RandomState(35)
+    x = rng.randn(1, 2, 4, 4).astype(np.float32)
+    xs = np.linspace(-1, 1, 4, dtype=np.float32)
+    ys = np.linspace(-1, 1, 4, dtype=np.float32)
+    grid = np.stack([np.tile(xs, (4, 1)),
+                     np.tile(ys[:, None], (1, 4))])[None]
+    s = sym.BilinearSampler(sym.Variable("data"), sym.Variable("grid"))
+    check_symbolic_forward(s, {"data": x, "grid": grid}, [x], rtol=1e-4,
+                           atol=1e-5)
+
+
+def test_svm_output_margins():
+    """SVMOutput backward: L1 hinge pushes margin violators by
+    +/-grad_scale; the true class collects the others' sum (reference
+    test_support_vector_machine_l1_svm)."""
+    x = np.array([[2.0, 0.5, -1.0]], np.float32)
+    y = np.array([0.0], np.float32)
+    s = sym.SVMOutput(sym.Variable("data"), sym.Variable("label"),
+                      margin=1.0, use_linear=True)
+    # forward passes scores through
+    check_symbolic_forward(s, {"data": x, "label": y}, [x], rtol=1e-6)
+    # margins: class 0 is true. violation_j = max(0, margin - (x_true - x_j))
+    # for j!=0: j=1: 1 - (2 - .5) = -.5 <=0 no push; j=2: 1 - 3 = -2 no.
+    og = np.ones_like(x)
+    check_symbolic_backward(s, {"data": x, "label": y}, [og],
+                            {"data": np.zeros_like(x)}, rtol=1e-6)
+    x2 = np.array([[0.2, 0.5, -1.0]], np.float32)
+    # j=1 violates (1 - (0.2-0.5) = 1.3 > 0); j=2: 1 - 1.2 <= 0 no
+    want = np.array([[-1.0, 1.0, 0.0]], np.float32)
+    check_symbolic_backward(s, {"data": x2, "label": y}, [og],
+                            {"data": want}, rtol=1e-6)
+
+
+def test_binary_logic_and_scalar_pow():
+    rng = np.random.RandomState(36)
+    a = rng.randint(0, 3, (3, 4)).astype(np.float32)
+    b = rng.randint(0, 3, (3, 4)).astype(np.float32)
+    for opname, fn in [("broadcast_equal", np.equal),
+                       ("broadcast_not_equal", np.not_equal),
+                       ("broadcast_greater", np.greater),
+                       ("broadcast_lesser_equal", np.less_equal),
+                       ("broadcast_logical_and",
+                        lambda p, q: np.logical_and(p, q)),
+                       ("broadcast_logical_xor",
+                        lambda p, q: np.logical_xor(p, q))]:
+        s = getattr(sym, opname)(sym.Variable("lhs"), sym.Variable("rhs"))
+        check_symbolic_forward(s, {"lhs": a, "rhs": b},
+                               [fn(a, b).astype(np.float32)], rtol=1e-6)
+    base = rng.rand(3, 3).astype(np.float32) + 0.5
+    s = sym._power_scalar(sym.Variable("data"), scalar=3.0)
+    check_symbolic_forward(s, {"data": base}, [base ** 3], rtol=1e-5)
+    check_numeric_gradient(s, {"data": base}, numeric_eps=1e-3,
+                           rtol=0.05, atol=1e-2)
+    s = sym._rpower_scalar(sym.Variable("data"), scalar=2.0)
+    check_symbolic_forward(s, {"data": base}, [2.0 ** base], rtol=1e-5)
+
+
+def test_batch_take_and_argmax_channel():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    idx = np.array([1, 0, 3], np.float32)
+    s = sym.batch_take(sym.Variable("a"), sym.Variable("indices"))
+    check_symbolic_forward(s, {"a": x, "indices": idx},
+                           [np.array([1., 4., 11.], np.float32)])
+    am = sym.argmax_channel(sym.Variable("data"))
+    check_symbolic_forward(am, {"data": x},
+                           [np.array([3., 3., 3.], np.float32)])
