@@ -14,6 +14,8 @@ from . import vgg
 from . import inception_bn
 from . import inception_v3
 from . import resnet
+from . import resnext
+from . import googlenet
 from . import lstm_lm
 from . import transformer
 from . import ssd
@@ -25,6 +27,8 @@ _BUILDERS = {
     "vgg": vgg.get_symbol,
     "vgg16": lambda **kw: vgg.get_symbol(num_layers=16, **kw),
     "vgg19": lambda **kw: vgg.get_symbol(num_layers=19, **kw),
+    "googlenet": googlenet.get_symbol,
+    "inception-v1": googlenet.get_symbol,
     "inception-bn": inception_bn.get_symbol,
     "inception-v3": inception_v3.get_symbol,
     "resnet": resnet.get_symbol,
@@ -33,6 +37,9 @@ _BUILDERS = {
     "resnet-50": lambda **kw: resnet.get_symbol(num_layers=50, **kw),
     "resnet-101": lambda **kw: resnet.get_symbol(num_layers=101, **kw),
     "resnet-152": lambda **kw: resnet.get_symbol(num_layers=152, **kw),
+    "resnext": resnext.get_symbol,
+    "resnext-50": lambda **kw: resnext.get_symbol(num_layers=50, **kw),
+    "resnext-101": lambda **kw: resnext.get_symbol(num_layers=101, **kw),
     "lstm-lm": lstm_lm.get_symbol,
     "transformer-lm": transformer.get_symbol,
     "ssd-vgg16": ssd.get_symbol,

@@ -18,6 +18,8 @@ IMAGE_MODELS = [
     ("resnet-18", (2, 3, 224, 224)),
     ("resnet-50", (2, 3, 224, 224)),
     ("resnet-152", (2, 3, 224, 224)),
+    ("googlenet", (2, 3, 224, 224)),
+    ("resnext-50", (2, 3, 224, 224)),
 ]
 
 
