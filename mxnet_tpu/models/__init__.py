@@ -40,6 +40,7 @@ _BUILDERS = {
     "resnext": resnext.get_symbol,
     "resnext-50": lambda **kw: resnext.get_symbol(num_layers=50, **kw),
     "resnext-101": lambda **kw: resnext.get_symbol(num_layers=101, **kw),
+    "resnext-152": lambda **kw: resnext.get_symbol(num_layers=152, **kw),
     "lstm-lm": lstm_lm.get_symbol,
     "transformer-lm": transformer.get_symbol,
     "ssd-vgg16": ssd.get_symbol,

@@ -29,30 +29,33 @@ def _inception(data, n1, n3r, n3, n5r, n5, proj, name, pool='max'):
 
 def get_symbol(num_classes=1000, **kwargs):
     data = sym.Variable("data")
+    # names and pooling conventions follow the reference symbol file
+    # EXACTLY (conv1..conv3, in3a..in5b, unnamed-FC auto-name) so
+    # reference-trained checkpoints load by parameter name
     x = _conv(data, 64, (7, 7), stride=(2, 2), pad=(3, 3), name="conv1")
-    x = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max",
-                    pooling_convention="full")
-    # stem names follow the reference symbol file exactly (conv2 = the
-    # 1x1 reduce, conv3 = the 3x3) so reference checkpoints load by name
+    x = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
     x = _conv(x, 64, (1, 1), name="conv2")
     x = _conv(x, 192, (3, 3), pad=(1, 1), name="conv3")
-    x = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max",
-                    pooling_convention="full")
-    x = _inception(x, 64, 96, 128, 16, 32, 32, "3a")
-    x = _inception(x, 128, 128, 192, 32, 96, 64, "3b")
-    x = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max",
-                    pooling_convention="full")
-    x = _inception(x, 192, 96, 208, 16, 48, 64, "4a")
-    x = _inception(x, 160, 112, 224, 24, 64, 64, "4b")
-    x = _inception(x, 128, 128, 256, 24, 64, 64, "4c")
-    x = _inception(x, 112, 144, 288, 32, 64, 64, "4d")
-    x = _inception(x, 256, 160, 320, 32, 128, 128, "4e")
-    x = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max",
-                    pooling_convention="full")
-    x = _inception(x, 256, 160, 320, 32, 128, 128, "5a")
-    x = _inception(x, 384, 192, 384, 48, 128, 128, "5b")
+    x = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    x = _inception(x, 64, 96, 128, 16, 32, 32, "in3a")
+    x = _inception(x, 128, 128, 192, 32, 96, 64, "in3b")
+    x = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    x = _inception(x, 192, 96, 208, 16, 48, 64, "in4a")
+    x = _inception(x, 160, 112, 224, 24, 64, 64, "in4b")
+    x = _inception(x, 128, 128, 256, 24, 64, 64, "in4c")
+    x = _inception(x, 112, 144, 288, 32, 64, 64, "in4d")
+    x = _inception(x, 256, 160, 320, 32, 128, 128, "in4e")
+    x = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    x = _inception(x, 256, 160, 320, 32, 128, 128, "in5a")
+    x = _inception(x, 384, 192, 384, 48, 128, 128, "in5b")
+    # the reference's fixed 7x7 avg kernel assumes a 7x7 final map (its
+    # Caffe ceil-mode lineage); global-avg is shape-robust and identical
+    # when the map IS the kernel size
     x = sym.Pooling(x, kernel=(7, 7), stride=(1, 1), pool_type="avg",
                     global_pool=True)
     x = sym.Flatten(x)
-    x = sym.FullyConnected(x, num_hidden=num_classes, name="fc1")
+    # the reference leaves this FullyConnected unnamed; pin its
+    # auto-name so checkpoint keys line up regardless of build order
+    x = sym.FullyConnected(x, num_hidden=num_classes,
+                           name="fullyconnected0")
     return sym.SoftmaxOutput(x, name="softmax")

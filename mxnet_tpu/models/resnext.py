@@ -51,24 +51,22 @@ def get_symbol(num_classes=1000, num_layers=50, num_group=32,
     units = _DEPTH_CONFIG[num_layers]
     filter_list = [64, 256, 512, 1024, 2048]
 
+    if image_shape[1] <= 32:
+        raise ValueError(
+            "resnext here is the ImageNet 4-stage configuration; the "
+            "reference's CIFAR variant uses a different 3-stage layout "
+            "(resnext.py num_stages=3) that is out of scope")
     data = sym.Variable(name='data')
     data = sym.BatchNorm(data=data, fix_gamma=True, eps=2e-5,
                          momentum=bn_mom, name='bn_data')
-    if image_shape[1] <= 32:  # CIFAR-style stem
-        body = sym.Convolution(data=data, num_filter=filter_list[0],
-                               kernel=(3, 3), stride=(1, 1), pad=(1, 1),
-                               no_bias=True, workspace=workspace,
-                               name='conv0')
-    else:
-        body = sym.Convolution(data=data, num_filter=filter_list[0],
-                               kernel=(7, 7), stride=(2, 2), pad=(3, 3),
-                               no_bias=True, workspace=workspace,
-                               name='conv0')
-        body = sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5,
-                             momentum=bn_mom, name='bn0')
-        body = sym.Activation(data=body, act_type='relu', name='relu0')
-        body = sym.Pooling(data=body, kernel=(3, 3), stride=(2, 2),
-                           pad=(1, 1), pool_type='max', name='pool0')
+    body = sym.Convolution(data=data, num_filter=filter_list[0],
+                           kernel=(7, 7), stride=(2, 2), pad=(3, 3),
+                           no_bias=True, workspace=workspace, name='conv0')
+    body = sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5,
+                         momentum=bn_mom, name='bn0')
+    body = sym.Activation(data=body, act_type='relu', name='relu0')
+    body = sym.Pooling(data=body, kernel=(3, 3), stride=(2, 2),
+                       pad=(1, 1), pool_type='max', name='pool0')
 
     for stage in range(4):
         stride = (1, 1) if stage == 0 else (2, 2)

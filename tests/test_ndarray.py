@@ -156,3 +156,146 @@ def test_take_onehot():
     oh = nd.one_hot(idx, depth=4)
     assert oh.shape == (2, 4)
     assert oh.asnumpy()[1, 2] == 1.0
+
+
+# --- tranche 2: the reference test_ndarray.py's adversarial surface
+# (setitem families, pickle, views, moveaxis, arange corners, order,
+# scalar reflection) re-expressed with independent numpy expectations --
+
+
+def test_setitem_families():
+    rng = np.random.RandomState(40)
+    for shape in ((3,), (3, 4), (2, 3, 4)):
+        x = rng.randn(*shape).astype(np.float32)
+        a = mx.nd.array(x)
+        # full assignment: scalar, ndarray, numpy
+        a[:] = 0.5
+        np.testing.assert_array_equal(a.asnumpy(), np.full(shape, 0.5,
+                                                           np.float32))
+        a[:] = x
+        np.testing.assert_array_equal(a.asnumpy(), x)
+        # int row, slice, negative index
+        if len(shape) > 1:
+            a[0] = 1.25
+            x2 = x.copy(); x2[0] = 1.25
+            np.testing.assert_array_equal(a.asnumpy(), x2)
+            a[-1] = x2[0]
+            x2[-1] = x2[0]
+            np.testing.assert_array_equal(a.asnumpy(), x2)
+            a[0:2] = 3.0
+            x2[0:2] = 3.0
+            np.testing.assert_array_equal(a.asnumpy(), x2)
+
+
+def test_elementwisesum_and_negate():
+    rng = np.random.RandomState(41)
+    arrs = [rng.randn(4, 3).astype(np.float32) for _ in range(5)]
+    out = mx.nd.add_n(*[mx.nd.array(v) for v in arrs])
+    np.testing.assert_allclose(out.asnumpy(), np.sum(arrs, axis=0),
+                               rtol=1e-6)
+    a = mx.nd.array(arrs[0])
+    np.testing.assert_array_equal((-a).asnumpy(), -arrs[0])
+
+
+def test_pickle_roundtrip():
+    import pickle
+
+    rng = np.random.RandomState(42)
+    for dt in ("float32", "int32", "uint8"):
+        x = (rng.rand(3, 4) * 10).astype(dt)
+        a = mx.nd.array(x, dtype=dt)
+        b = pickle.loads(pickle.dumps(a))
+        assert b.dtype == np.dtype(dt)
+        np.testing.assert_array_equal(b.asnumpy(), x)
+
+
+def test_slice_and_crop_views():
+    rng = np.random.RandomState(43)
+    x = rng.randn(6, 5).astype(np.float32)
+    a = mx.nd.array(x)
+    np.testing.assert_array_equal(a[2:5].asnumpy(), x[2:5])
+    np.testing.assert_array_equal(a[1].asnumpy(), x[1])
+    np.testing.assert_array_equal(
+        mx.nd.crop(a, begin=(1, 1), end=(4, 4)).asnumpy(), x[1:4, 1:4])
+    np.testing.assert_array_equal(
+        mx.nd.slice_axis(a, axis=1, begin=-3, end=None).asnumpy(),
+        x[:, -3:])
+
+
+def test_moveaxis_and_swapaxes():
+    rng = np.random.RandomState(44)
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    a = mx.nd.array(x)
+    np.testing.assert_array_equal(
+        mx.nd.moveaxis(a, 0, 2).asnumpy(), np.moveaxis(x, 0, 2))
+    np.testing.assert_array_equal(
+        mx.nd.swapaxes(a, dim1=0, dim2=2).asnumpy(), x.swapaxes(0, 2))
+
+
+def test_arange_corners():
+    # reference test_arange: start/stop/step/repeat/dtype combos
+    cases = [dict(start=0, stop=5),
+             dict(start=2, stop=10, step=2),
+             dict(start=0, stop=3, step=0.5),
+             dict(start=5, stop=0, step=-1),
+             dict(start=0, stop=4, repeat=2)]
+    for kw in cases:
+        got = mx.nd.arange(**kw).asnumpy()
+        rep = kw.pop("repeat", 1)
+        want = np.arange(kw["start"], kw["stop"], kw.get("step", 1.0),
+                         dtype=np.float32).repeat(rep)
+        np.testing.assert_allclose(got, want, rtol=1e-6,
+                                   err_msg=str(kw))
+
+
+def test_order_nd_level():
+    rng = np.random.RandomState(45)
+    x = rng.permutation(20).reshape(4, 5).astype(np.float32)
+    a = mx.nd.array(x)
+    np.testing.assert_array_equal(mx.nd.sort(a, axis=1).asnumpy(),
+                                  np.sort(x, axis=1))
+    np.testing.assert_array_equal(
+        mx.nd.argsort(a, axis=0, is_ascend=False).asnumpy(),
+        np.argsort(-x, axis=0).astype(np.float32))
+    tk = mx.nd.topk(a, axis=1, k=2, ret_typ="value")
+    np.testing.assert_array_equal(tk.asnumpy(), -np.sort(-x, axis=1)[:, :2])
+
+
+def test_scalar_reflected_ops():
+    rng = np.random.RandomState(46)
+    x = rng.rand(3, 3).astype(np.float32) + 0.5
+    a = mx.nd.array(x)
+    np.testing.assert_allclose((2.0 - a).asnumpy(), 2.0 - x, rtol=1e-6)
+    np.testing.assert_allclose((2.0 / a).asnumpy(), 2.0 / x, rtol=1e-6)
+    np.testing.assert_allclose((a ** 2).asnumpy(), x ** 2, rtol=1e-5)
+    np.testing.assert_allclose((1.0 + a).asnumpy(), 1.0 + x, rtol=1e-6)
+    np.testing.assert_allclose((a * 3.0).asnumpy(), x * 3.0, rtol=1e-6)
+
+
+def test_comparison_operators_nd():
+    a = mx.nd.array(np.array([[1., 2.], [3., 4.]], np.float32))
+    b = mx.nd.array(np.array([[1., 3.], [2., 4.]], np.float32))
+    np.testing.assert_array_equal((a == b).asnumpy(),
+                                  np.array([[1., 0.], [0., 1.]]))
+    np.testing.assert_array_equal((a > b).asnumpy(),
+                                  np.array([[0., 0.], [1., 0.]]))
+    np.testing.assert_array_equal((a <= b).asnumpy(),
+                                  np.array([[1., 1.], [0., 1.]]))
+    np.testing.assert_array_equal((a != 2.0).asnumpy(),
+                                  np.array([[1., 0.], [1., 1.]]))
+
+
+def test_choose_fill_iter():
+    x = np.array([[1., 2.], [3., 4.], [5., 6.]], np.float32)
+    a = mx.nd.array(x)
+    idx = mx.nd.array(np.array([0., 1., 0.], np.float32))
+    np.testing.assert_array_equal(
+        mx.nd.pick(a, idx, axis=1).asnumpy(), np.array([1., 4., 5.]))
+    # iteration yields first-axis slices (reference test_iter)
+    rows = [r.asnumpy() for r in a]
+    assert len(rows) == 3
+    np.testing.assert_array_equal(np.stack(rows), x)
+    # onehot_encode fill semantics
+    oh = mx.nd.one_hot(idx, depth=2)
+    np.testing.assert_array_equal(oh.asnumpy(),
+                                  np.array([[1., 0.], [0., 1.], [1., 0.]]))
