@@ -34,7 +34,7 @@ def score(network, batch, dtype, iters, dev):
     from mxnet_tpu import models
 
     sym = models.get_symbol(network, num_classes=1000)
-    shape = (batch, 3, 299, 299) if "v3" in network else (batch, 3, 224, 224)
+    shape = (batch, 3, 299, 299) if ("v3" in network or "resnet-v2" in network) else (batch, 3, 224, 224)
     exe = sym.simple_bind(dev, grad_req="null",
                           compute_dtype=None if dtype == "float32" else dtype,
                           data=shape, softmax_label=(batch,))

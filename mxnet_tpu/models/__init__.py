@@ -13,6 +13,7 @@ from . import alexnet
 from . import vgg
 from . import inception_bn
 from . import inception_v3
+from . import inception_resnet_v2
 from . import resnet
 from . import resnext
 from . import googlenet
@@ -31,6 +32,7 @@ _BUILDERS = {
     "inception-v1": googlenet.get_symbol,
     "inception-bn": inception_bn.get_symbol,
     "inception-v3": inception_v3.get_symbol,
+    "inception-resnet-v2": inception_resnet_v2.get_symbol,
     "resnet": resnet.get_symbol,
     "resnet-18": lambda **kw: resnet.get_symbol(num_layers=18, **kw),
     "resnet-34": lambda **kw: resnet.get_symbol(num_layers=34, **kw),

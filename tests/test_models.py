@@ -19,6 +19,7 @@ IMAGE_MODELS = [
     ("resnet-50", (2, 3, 224, 224)),
     ("resnet-152", (2, 3, 224, 224)),
     ("googlenet", (2, 3, 224, 224)),
+    ("inception-resnet-v2", (2, 3, 299, 299)),
     ("resnext-50", (2, 3, 224, 224)),
 ]
 
