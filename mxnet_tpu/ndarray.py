@@ -520,11 +520,22 @@ def imdecode(buf, **kwargs):  # placed in mx.image in the full pipeline
 _NDLIST_MAGIC = 0x112
 
 
-def save(fname: str, data) -> None:
+def save(fname: str, data, format: str = "npz") -> None:
     """Save dict/list of NDArrays (npz container with the reference's
-    arg:/aux: naming preserved by callers)."""
+    arg:/aux: naming preserved by callers). ``format="reference"``
+    writes the reference ecosystem's dmlc .params blob instead
+    (interop.save_params), so artifacts round-trip back into reference
+    tooling; nd.load auto-detects either on read."""
+    if format not in ("npz", "reference"):
+        raise ValueError("nd.save format must be 'npz' or 'reference', "
+                         "got %r" % (format,))
     if isinstance(data, NDArray):
         data = [data]
+    if format == "reference":
+        from . import interop
+
+        interop.save_params(fname, data)
+        return
     if isinstance(data, dict):
         payload = {k: np.asarray(v._data) for k, v in data.items()}
         np.savez(fname, __format__="dict", **payload)

@@ -209,3 +209,31 @@ def test_argmax_axis_rewrite_gated_on_version():
     new = graph(10000).infer_shape(x=(2, 3))[1][0]
     assert new == (2,)
     assert old != (2,)
+
+
+def test_nd_save_reference_format(tmp_path):
+    """nd.save(format="reference") writes the dmlc blob; nd.load
+    auto-detects it — the full round trip through the public API."""
+    rng = np.random.RandomState(9)
+    data = {"arg:w": mx.nd.array(rng.randn(2, 3).astype(np.float32)),
+            "aux:s": mx.nd.array(rng.rand(4).astype(np.float32))}
+    p = str(tmp_path / "out.params")
+    mx.nd.save(p, data, format="reference")
+    with open(p, "rb") as f:
+        head = f.read(8)
+    assert interop.is_reference_params(head)
+    back = mx.nd.load(p)
+    for k in data:
+        np.testing.assert_array_equal(back[k].asnumpy(),
+                                      data[k].asnumpy())
+
+
+def test_nd_save_reference_single_array_and_bad_format(tmp_path):
+    a = mx.nd.array(np.ones((4, 3), np.float32))
+    p = str(tmp_path / "single.params")
+    mx.nd.save(p, a, format="reference")
+    back = mx.nd.load(p)
+    assert isinstance(back, list) and len(back) == 1
+    np.testing.assert_array_equal(back[0].asnumpy(), a.asnumpy())
+    with pytest.raises(ValueError, match="format"):
+        mx.nd.save(str(tmp_path / "x"), a, format="dmlc")
