@@ -152,3 +152,57 @@ def test_bi_lstm_sort_example():
     sorting transduction a unidirectional model cannot learn."""
     out = _run("examples/bi-lstm-sort/sort_io.py", "--epochs", "5")
     assert "bi-lstm-sort OK" in out
+
+
+def test_fcn_segmentation_example():
+    """FCN skip-architecture surface: bilinear-initialized Deconvolution,
+    two-input Crop alignment, per-pixel SoftmaxOutput(multi_output) with
+    ignore_label, Mixed pattern-based init."""
+    out = _run("examples/fcn-xs/fcn_segmentation.py", "--steps", "25")
+    assert "decreasing" in out and "NOT decreasing" not in out
+
+
+def test_recommender_example():
+    """Embedding-factor matrix factorization through FeedForward +
+    CustomMetric + multi-input NDArrayIter."""
+    out = _run("examples/recommenders/matrix_fact.py", "--epochs", "6")
+    assert "recommender OK" in out
+
+
+def test_svm_mnist_example():
+    """SVMOutput training head in both margin modes (L2 and use_linear)."""
+    out = _run("examples/svm_mnist/svm_mnist.py", "--epochs", "5")
+    assert "svm_mnist OK" in out
+
+
+def test_sgld_example():
+    """SGLD optimizer as a posterior sampler: chain statistics must match
+    the analytic Bayesian linear-regression posterior."""
+    out = _run("examples/bayesian-methods/sgld_demo.py", "--iters", "3000")
+    assert "sgld posterior OK" in out
+
+
+def test_stochastic_depth_example():
+    """Per-batch Bernoulli block gating fed as data streams (the XLA-native
+    form of stochastic depth's random layer skip)."""
+    out = _run("examples/stochastic-depth/sd_mnist.py", "--steps", "60")
+    assert "stochastic-depth OK" in out
+
+
+def test_numpy_ops_example():
+    """CustomOp loss head (need_top_grad=False) training an MLP through
+    the pure_callback custom-op bridge."""
+    out = _run("examples/numpy-ops/custom_softmax.py", "--epochs", "5")
+    assert "numpy-ops OK" in out
+
+
+def test_rnn_time_major_example():
+    """unroll(layout='TNC') equivalence with NTC plus time-major training."""
+    out = _run("examples/rnn-time-major/rnn_time_major.py", "--steps", "70")
+    assert "rnn-time-major OK" in out
+
+
+def test_profiler_example():
+    """profiler_set_config/state bracketing writes a non-empty trace."""
+    out = _run("examples/profiler/profiler_matmul.py", "--iters", "10")
+    assert "profiler OK" in out
