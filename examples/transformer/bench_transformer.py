@@ -357,9 +357,10 @@ def long_context(args):
                          stats.get("bytes_in_use", 0)) / 1e9
         limit = stats.get("bytes_limit", 0) / 1e9
         rows.append((seq, 1 * seq / t, t * 1e3, used, limit))
+        hbm = ("HBM %.2f/%.2f GB" % (used, limit) if limit
+               else "HBM n/a (runtime exposes no memory_stats)")
         print("long-context seq=%d (bs1, remat, GQA hkv=%d): %.1f ms/step"
-              "  %.0f tokens/s  HBM %.2f/%.2f GB"
-              % (seq, kv_heads, t * 1e3, seq / t, used, limit))
+              "  %.0f tokens/s  %s" % (seq, kv_heads, t * 1e3, seq / t, hbm))
     return rows
 
 

@@ -7,6 +7,22 @@ block, streams key/value blocks through VMEM, and keeps the softmax
 running-max/running-sum in registers (f32) — the standard
 memory-bandwidth-optimal formulation for the MXU.
 
+Two VMEM regimes, selected per shape:
+
+- **resident** (seq <= _RESIDENT_MAX): the whole K/V (or, in the dK/dV
+  kernel, Q/dO) sequence sits in VMEM per grid cell and an in-kernel loop
+  walks its tiles with the carry in registers. Fastest form — no scratch
+  traffic, minimal grid steps — but VMEM scales with sequence length, so
+  it hits the 16 MiB scoped-VMEM wall just past 8k at head_dim 128.
+- **streaming** (longer): the sequence streams through an extra innermost
+  grid dim one ~SUPER_TARGET-sized superblock at a time, the kernel loops
+  the superblock's tiles in registers, and the carry lives in VMEM
+  scratch across supersteps. Nothing in VMEM scales with total sequence
+  length, so 16k/32k+ train in the same footprint as 4k. Measured ~1.5-2x
+  slower than resident at seqs where both run (per-superstep scratch
+  spill/fill + grid overhead), which is why it only engages where
+  resident cannot run at all.
+
 Falls back to the XLA reference math off-TPU or for non-tile-aligned
 shapes, exactly as the reference falls back from cuDNN to the mshadow
 kernel (src/operator/convolution.cc cudnn_off path).
@@ -32,11 +48,37 @@ BLOCK_K = 256
 # amortize the kernel's per-block softmax bookkeeping worse than XLA's
 # fused einsum. Gate to sequences where it measurably wins.
 MIN_SEQ = 1024
+# Longest sequence whose K/V (one side) stays whole in VMEM: 8192 * 128
+# lanes * 2B = 2 MiB per buffer, measured to fit alongside everything
+# else; 16384 exceeds the 16 MiB scoped-VMEM limit (the compile error
+# that motivated the streaming regime).
+_RESIDENT_MAX = 8192
+# Streaming superblock target size (keys or queries per grid step).
+SUPER_TARGET = 4096
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, causal, scale,
-               block_k, offset):
+def _split_super(t, block, target=None):
+    """(super, n_super): split a sequence of length t (a multiple of
+    `block`, per the kernel contract) into equal superblocks, each a
+    multiple of `block`, sized as close to `target` as divisibility
+    allows. The superblock is the unit resident in VMEM per grid step;
+    `block` stays the unit of one in-kernel loop iteration."""
+    target = target or SUPER_TARGET
+    nblocks = t // block
+    # a target below the block size would start nsup above nblocks and
+    # the divisibility walk could never terminate; one block per
+    # superblock is the finest legal split
+    nsup = min(max(1, -(-t // target)), nblocks)
+    while nblocks % nsup:
+        nsup += 1
+    return t // nsup, nsup
+
+
+# --- forward, resident regime ----------------------------------------------
+
+def _fa_kernel_res(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, causal,
+                   scale, block_k, offset):
     """One (batch*kv-head, group, q-block) grid cell. Writes O, and the
     per-row logsumexp when a ref for it is supplied (training forward —
     the blocked backward needs it; inference skips the extra HBM write).
@@ -95,6 +137,115 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, causal, scale,
         maybe_lse_ref[0][0, 0, 0] = m + jnp.log(l)
 
 
+# --- forward, streaming regime ---------------------------------------------
+
+def _fa_kernel_stream(q_ref, k_ref, v_ref, o_ref, *rest, causal, scale,
+                      block_k, offset, with_lse, num_super):
+    """One (batch*kv-head, group, q-block, k-superblock) grid cell. K/V
+    stream through the grid's innermost dim one superblock at a time, the
+    kernel loops over its block_k tiles with the online-softmax state in
+    registers, and the state is carried ACROSS supersteps in VMEM scratch
+    (acc, running max, running sum). O/lse flush on the last superstep."""
+    lse_ref = rest[0] if with_lse else None
+    acc_ref, m_ref, l_ref = rest[-3:]
+    bq = q_ref.shape[2]
+    sk = k_ref.shape[1]                                # superblock size
+    qi = pl.program_id(2)
+    ski = pl.program_id(3)
+    inner = pl.cdiv(sk, block_k)
+
+    @pl.when(ski == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale    # (BQ, D)
+
+        def body(kb, carry):
+            acc, m_prev, l_prev = carry
+            k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(
+                jnp.float32)
+            v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(
+                jnp.float32)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)    # (BQ, BK)
+            if causal:
+                q_pos = qi * bq + offset + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, block_k), 0)
+                k_pos = (ski * sk + kb * block_k
+                         + jax.lax.broadcasted_iota(
+                             jnp.int32, (bq, block_k), 1))
+                s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            m_cur = jnp.max(s, axis=1)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(s - m_new[:, None])            # (BQ, BK)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = l_prev * alpha + jnp.sum(p, axis=1)
+            acc = acc * alpha[:, None] + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return acc, m_new, l_new
+
+        if causal:
+            # only tiles at or left of the (offset) diagonal contribute
+            hi = jnp.clip(
+                pl.cdiv((qi + 1) * bq + offset - ski * sk, block_k),
+                0, inner)
+        else:
+            hi = inner
+        # run the superblock with a REGISTER-local carry (seeding the
+        # loop from scratch refs measured 2x slower — Mosaic pins the
+        # carry to VMEM), then merge with the running state through the
+        # logsumexp once per superstep — the ring-attention shard merge
+        d = q_ref.shape[-1]
+        init = (jnp.zeros((bq, d), jnp.float32),
+                jnp.full((bq,), _NEG_INF, jnp.float32),
+                jnp.zeros((bq,), jnp.float32))
+        acc_l, m_l, l_l = jax.lax.fori_loop(0, hi, body, init)
+        m_prev, l_prev = m_ref[0], l_ref[0]
+        m_new = jnp.maximum(m_prev, m_l)
+        a_prev = jnp.exp(m_prev - m_new)
+        a_l = jnp.exp(m_l - m_new)
+        m_ref[0] = m_new
+        l_ref[0] = l_prev * a_prev + l_l * a_l
+        acc_ref[...] = (acc_ref[...] * a_prev[:, None]
+                        + acc_l * a_l[:, None])
+
+    if causal:
+        # supersteps strictly right of the diagonal contribute nothing:
+        # skip the compute (their K/V fetch is also elided — the index
+        # map clamps to the diagonal superblock, and Pallas only issues
+        # a DMA when the block index CHANGES)
+        pl.when(ski * sk <= qi * bq + offset + bq - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ski == num_super - 1)
+    def _finalize():
+        l = l_ref[0]
+        o_ref[0, 0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+        if with_lse:
+            lse_ref[0, 0, 0] = m_ref[0] + jnp.log(l)
+
+
+def _kv_stream_idx(block_q, super_k, offset, causal):
+    """Index map for K/V superblocks streamed under a (b, g, qi, ski)
+    grid. Causal grids clamp ski to this q-block's diagonal superblock so
+    the fully-masked tail re-addresses the same superblock (no DMA) while
+    the kernel skips its compute."""
+    if not causal:
+        return lambda b, gi, qi, ski: (b, ski, 0)
+
+    def idx(b, gi, qi, ski):
+        hi = jax.lax.div(qi * block_q + block_q - 1 + offset, super_k)
+        return (b, jnp.minimum(ski, hi), 0)
+
+    return idx
+
+
 def _fa_forward(q, k, v, causal, scale, interpret, with_lse=False):
     """q: (B*Hkv, G, Tq, D); k/v: (B*Hkv, Tk, D). Returns (B*Hkv, G, Tq,
     D) [+ lse (B*Hkv, G, 1, Tq) — the singleton keeps the last two block
@@ -103,40 +254,82 @@ def _fa_forward(q, k, v, causal, scale, interpret, with_lse=False):
     tk = k.shape[1]
     block_q = min(BLOCK_Q, tq)
     block_k = min(BLOCK_K, tk)
-    kernel = functools.partial(_fa_kernel, causal=causal, scale=scale,
-                               block_k=block_k, offset=tk - tq)
+    resident = tk <= _RESIDENT_MAX
     kwargs = {}
-    if pltpu is not None and not interpret:
-        kwargs["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary", "arbitrary"))
-    out_specs = [pl.BlockSpec((1, 1, block_q, d),
-                              lambda b, gi, i: (b, gi, i, 0))]
+    out_specs3 = [pl.BlockSpec((1, 1, block_q, d),
+                               lambda b, gi, i: (b, gi, i, 0))]
+    out_specs4 = [pl.BlockSpec((1, 1, block_q, d),
+                               lambda b, gi, i, ski: (b, gi, i, 0))]
     out_shape = [jax.ShapeDtypeStruct((bkv, g, tq, d), q.dtype)]
     if with_lse:
         # (bkv, g, 1, tq): TPU block rules need the last two block dims
         # divisible by (8, 128) or EQUAL to the array dims — the
         # singleton third dim gives (1, BQ) blocks with 1 == array dim
-        out_specs.append(pl.BlockSpec((1, 1, 1, block_q),
-                                      lambda b, gi, i: (b, gi, 0, i)))
+        out_specs3.append(pl.BlockSpec((1, 1, 1, block_q),
+                                       lambda b, gi, i: (b, gi, 0, i)))
+        out_specs4.append(pl.BlockSpec((1, 1, 1, block_q),
+                                       lambda b, gi, i, ski: (b, gi, 0, i)))
         out_shape.append(jax.ShapeDtypeStruct((bkv, g, 1, tq),
                                               jnp.float32))
+    cost = pl.CostEstimate(
+        flops=4 * bkv * g * tq * tk * d,
+        bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
+        transcendentals=bkv * g * tq * tk,
+    )
+    if resident:
+        kernel = functools.partial(_fa_kernel_res, causal=causal,
+                                   scale=scale, block_k=block_k,
+                                   offset=tk - tq)
+        if pltpu is not None and not interpret:
+            kwargs["compiler_params"] = pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary", "arbitrary"))
+        res = pl.pallas_call(
+            kernel,
+            grid=(bkv, g, pl.cdiv(tq, block_q)),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda b, gi, i: (b, gi, i, 0)),
+                # k/v block index ignores (gi, i): Pallas re-fetches only
+                # on index change, so K/V stream from HBM once per KV head
+                pl.BlockSpec((1, tk, d), lambda b, gi, i: (b, 0, 0)),
+                pl.BlockSpec((1, tk, d), lambda b, gi, i: (b, 0, 0)),
+            ],
+            out_specs=out_specs3,
+            out_shape=out_shape,
+            cost_estimate=cost,
+            interpret=interpret,
+            **kwargs,
+        )(q, k, v)
+        return (res[0], res[1]) if with_lse else res[0]
+    if pltpu is None:  # pragma: no cover - guarded by flash_attention()
+        raise RuntimeError("pallas TPU backend unavailable")
+    super_k, num_super = _split_super(tk, block_k)
+    kernel = functools.partial(_fa_kernel_stream, causal=causal,
+                               scale=scale, block_k=block_k,
+                               offset=tk - tq, with_lse=with_lse,
+                               num_super=num_super)
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary",
+                                 "arbitrary"))
+    kv_idx = _kv_stream_idx(block_q, super_k, tk - tq, causal)
     res = pl.pallas_call(
         kernel,
-        grid=(bkv, g, pl.cdiv(tq, block_q)),
+        grid=(bkv, g, pl.cdiv(tq, block_q), num_super),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, gi, i: (b, gi, i, 0)),
-            # k/v block index ignores (gi, i): Pallas re-fetches only on
-            # index change, so K/V stream from HBM once per KV head
-            pl.BlockSpec((1, tk, d), lambda b, gi, i: (b, 0, 0)),
-            pl.BlockSpec((1, tk, d), lambda b, gi, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b, gi, i, ski: (b, gi, i, 0)),
+            pl.BlockSpec((1, super_k, d), kv_idx),
+            pl.BlockSpec((1, super_k, d), kv_idx),
         ],
-        out_specs=out_specs,
+        out_specs=out_specs4,
         out_shape=out_shape,
-        cost_estimate=pl.CostEstimate(
-            flops=4 * bkv * g * tq * tk * d,
-            bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
-            transcendentals=bkv * g * tq * tk,
-        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),     # acc
+            pltpu.VMEM((1, block_q), jnp.float32),     # running max
+            pltpu.VMEM((1, block_q), jnp.float32),     # running sum
+        ],
+        cost_estimate=cost,
         interpret=interpret,
         **kwargs,
     )(q, k, v)
@@ -145,8 +338,8 @@ def _fa_forward(q, k, v, causal, scale, interpret, with_lse=False):
 
 # --- blocked backward (FlashAttention-2 style: no S^2 materialization) ------
 
-def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
-                      dq_ref, *, causal, scale, block_k, offset):
+def _fa_bwd_dq_kernel_res(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
+                          dq_ref, *, causal, scale, block_k, offset):
     """dQ for one (batch*kv-head, group, q-block): stream k/v blocks,
     rebuild p from the saved logsumexp, dq += (p * (dO v^T - D)) @ k *
     scale."""
@@ -185,8 +378,79 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
     dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
-                       dk_ref, dv_ref, *, causal, scale, block_q, offset):
+def _fa_bwd_dq_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                             dvec_ref, dq_ref, dq_acc_ref, *, causal,
+                             scale, block_k, offset, num_super):
+    """dQ for one (batch*kv-head, group, q-block): k/v SUPERBLOCKS stream
+    through the grid's innermost dim, the kernel loops their block_k
+    tiles rebuilding p from the saved logsumexp, and dq accumulates
+    across supersteps in VMEM scratch, flushed on the last superstep."""
+    bq = q_ref.shape[2]
+    sk = k_ref.shape[1]
+    qi = pl.program_id(2)
+    ski = pl.program_id(3)
+    inner = pl.cdiv(sk, block_k)
+
+    @pl.when(ski == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)        # (BQ, D)
+        do = do_ref[0, 0].astype(jnp.float32)      # (BQ, D)
+        lse = lse_ref[0, 0, 0]                     # (BQ,)
+        dvec = dvec_ref[0, 0, 0]                   # (BQ,)
+
+        def body(kb, dq):
+            k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(
+                jnp.float32)
+            v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(
+                jnp.float32)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if causal:
+                q_pos = qi * bq + offset + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, block_k), 0)
+                k_pos = (ski * sk + kb * block_k
+                         + jax.lax.broadcasted_iota(
+                             jnp.int32, (bq, block_k), 1))
+                s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            p = jnp.exp(s - lse[:, None])          # (BQ, BK), rows sum<=1
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - dvec[:, None])
+            return dq + jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        if causal:
+            hi = jnp.clip(
+                pl.cdiv((qi + 1) * bq + offset - ski * sk, block_k),
+                0, inner)
+        else:
+            hi = inner
+        # register-local accumulation, one scratch add per superstep
+        # (seeding the loop carry from scratch pins it to VMEM — see the
+        # forward kernel's note)
+        dq_l = jax.lax.fori_loop(
+            0, hi, body,
+            jnp.zeros((q_ref.shape[2], q_ref.shape[3]), jnp.float32))
+        dq_acc_ref[...] += dq_l
+
+    if causal:
+        pl.when(ski * sk <= qi * bq + offset + bq - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ski == num_super - 1)
+    def _finalize():
+        dq_ref[0, 0] = (dq_acc_ref[...] * scale).astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel_res(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
+                           dk_ref, dv_ref, *, causal, scale, block_q,
+                           offset):
     """dK/dV for one (batch*kv-head, k-block) pair: stream q/dO blocks.
     The grid's LAST dim iterates the query-head group sequentially,
     accumulating each group head's contribution into the same dk/dv
@@ -254,6 +518,93 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
         dv_ref[0] += dv
 
 
+def _fa_bwd_dkv_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                              dvec_ref, dk_ref, dv_ref, dk_acc_ref,
+                              dv_acc_ref, *, causal, scale, block_q,
+                              offset, g, num_q_super):
+    """dK/dV for one (batch*kv-head, k-block) pair: q/dO/lse/D stream
+    through the two inner grid dims (group head, then q-SUPERBLOCK, whose
+    block_q tiles the kernel loops over) while K/V stay resident, and
+    dk/dv accumulate across ALL of them in f32 VMEM scratch — the GQA kv
+    gradient is the sum over the group — flushed once on the final
+    (group, q-superblock) step. Nothing in VMEM scales with total
+    sequence length."""
+    bk = k_ref.shape[1]
+    sq = q_ref.shape[2]                            # q superblock size
+    ki = pl.program_id(1)
+    gi = pl.program_id(2)
+    qsi = pl.program_id(3)
+    inner = pl.cdiv(sq, block_q)
+
+    @pl.when((gi == 0) & (qsi == 0))
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    def _compute():
+        k = k_ref[0].astype(jnp.float32)           # (BK, D)
+        v = v_ref[0].astype(jnp.float32)
+
+        def body(qb, carry):
+            dk, dv = carry
+            q = q_ref[0, 0, pl.ds(qb * block_q, block_q), :].astype(
+                jnp.float32)
+            do = do_ref[0, 0, pl.ds(qb * block_q, block_q), :].astype(
+                jnp.float32)
+            lse = lse_ref[0, 0, 0, pl.ds(qb * block_q, block_q)]
+            dvec = dvec_ref[0, 0, 0, pl.ds(qb * block_q, block_q)]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if causal:
+                q_pos = (qsi * sq + qb * block_q + offset
+                         + jax.lax.broadcasted_iota(
+                             jnp.int32, (block_q, bk), 0))
+                k_pos = ki * bk + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, bk), 1)
+                s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            p = jnp.exp(s - lse[:, None])          # (BQ, BK)
+            dv = dv + jax.lax.dot_general(
+                p, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - dvec[:, None])
+            dk = dk + jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return dk, dv
+
+        if causal:
+            # tiles whose last (offset) query position precedes this k
+            # block's start contribute nothing (every entry masked)
+            lo = jnp.clip(
+                jax.lax.div(ki * bk - offset - qsi * sq, block_q),
+                0, inner)
+        else:
+            lo = 0
+        # register-local accumulation, one scratch add per superstep
+        d = k_ref.shape[2]
+        dk_l, dv_l = jax.lax.fori_loop(
+            lo, inner, body,
+            (jnp.zeros((bk, d), jnp.float32),
+             jnp.zeros((bk, d), jnp.float32)))
+        dk_acc_ref[...] += dk_l
+        dv_acc_ref[...] += dv_l
+
+    if causal:
+        # q superblocks entirely above the diagonal are skipped; their
+        # q-side fetches are elided by the clamped index map
+        pl.when(qsi * sq + sq - 1 + offset >= ki * bk)(_compute)
+    else:
+        _compute()
+
+    @pl.when((gi == g - 1) & (qsi == num_q_super - 1))
+    def _finalize():
+        dk_ref[0] = (dk_acc_ref[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
 def _fa_backward(q, k, v, o, lse, do, causal, scale, interpret,
                  g_lse=None):
     """q/o/do: (B*Hkv, G, Tq, D); k/v: (B*Hkv, Tk, D); lse: (B*Hkv, G, 1,
@@ -271,67 +622,164 @@ def _fa_backward(q, k, v, o, lse, do, causal, scale, interpret,
                    axis=-1)[:, :, None, :]         # (bkv, g, 1, tq)
     if g_lse is not None:
         dvec = dvec - g_lse.astype(jnp.float32)
-    kwargs = {}
+    kwargs3 = {}
+    kwargs4 = {}
     if pltpu is not None and not interpret:
-        kwargs["compiler_params"] = pltpu.CompilerParams(
+        kwargs3["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"))
-    dq = pl.pallas_call(
-        functools.partial(_fa_bwd_dq_kernel, causal=causal, scale=scale,
-                          block_k=block_k, offset=tk - tq),
-        grid=(bkv, g, pl.cdiv(tq, block_q)),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, gi, i: (b, gi, i, 0)),
-            pl.BlockSpec((1, tk, d), lambda b, gi, i: (b, 0, 0)),
-            pl.BlockSpec((1, tk, d), lambda b, gi, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, block_q, d), lambda b, gi, i: (b, gi, i, 0)),
-            pl.BlockSpec((1, 1, 1, block_q), lambda b, gi, i: (b, gi, 0, i)),
-            pl.BlockSpec((1, 1, 1, block_q), lambda b, gi, i: (b, gi, 0, i)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda b, gi, i: (b, gi, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bkv, g, tq, d), q.dtype),
-        cost_estimate=pl.CostEstimate(
-            flops=6 * bkv * g * tq * tk * d,
-            bytes_accessed=(q.size + k.size + v.size + do.size)
-            * q.dtype.itemsize,
-            transcendentals=bkv * g * tq * tk),
-        interpret=interpret,
-        **kwargs,
-    )(q, k, v, do, lse, dvec)
-    # dk/dv accumulate over the group inside the kernel; for g > 1 the
-    # running sum lives in the output block, so keep it f32 and cast
-    # after (bf16 += per group head would round g times)
-    kv_acc_dtype = k.dtype if g == 1 else jnp.float32
+        kwargs4["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary",
+                                 "arbitrary"))
+    dq_cost = pl.CostEstimate(
+        flops=6 * bkv * g * tq * tk * d,
+        bytes_accessed=(q.size + k.size + v.size + do.size)
+        * q.dtype.itemsize,
+        transcendentals=bkv * g * tq * tk)
+    if tk <= _RESIDENT_MAX:
+        dq = pl.pallas_call(
+            functools.partial(_fa_bwd_dq_kernel_res, causal=causal,
+                              scale=scale, block_k=block_k,
+                              offset=tk - tq),
+            grid=(bkv, g, pl.cdiv(tq, block_q)),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda b, gi, i: (b, gi, i, 0)),
+                pl.BlockSpec((1, tk, d), lambda b, gi, i: (b, 0, 0)),
+                pl.BlockSpec((1, tk, d), lambda b, gi, i: (b, 0, 0)),
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda b, gi, i: (b, gi, i, 0)),
+                pl.BlockSpec((1, 1, 1, block_q),
+                             lambda b, gi, i: (b, gi, 0, i)),
+                pl.BlockSpec((1, 1, 1, block_q),
+                             lambda b, gi, i: (b, gi, 0, i)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, block_q, d),
+                                   lambda b, gi, i: (b, gi, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((bkv, g, tq, d), q.dtype),
+            cost_estimate=dq_cost,
+            interpret=interpret,
+            **kwargs3,
+        )(q, k, v, do, lse, dvec)
+    else:
+        if pltpu is None:  # pragma: no cover
+            raise RuntimeError("pallas TPU backend unavailable")
+        super_k, num_k_super = _split_super(tk, block_k)
+        kv_idx = _kv_stream_idx(block_q, super_k, tk - tq, causal)
+        dq = pl.pallas_call(
+            functools.partial(_fa_bwd_dq_kernel_stream, causal=causal,
+                              scale=scale, block_k=block_k,
+                              offset=tk - tq, num_super=num_k_super),
+            grid=(bkv, g, pl.cdiv(tq, block_q), num_k_super),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda b, gi, i, ski: (b, gi, i, 0)),
+                pl.BlockSpec((1, super_k, d), kv_idx),
+                pl.BlockSpec((1, super_k, d), kv_idx),
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda b, gi, i, ski: (b, gi, i, 0)),
+                pl.BlockSpec((1, 1, 1, block_q),
+                             lambda b, gi, i, ski: (b, gi, 0, i)),
+                pl.BlockSpec((1, 1, 1, block_q),
+                             lambda b, gi, i, ski: (b, gi, 0, i)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, block_q, d),
+                                   lambda b, gi, i, ski: (b, gi, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((bkv, g, tq, d), q.dtype),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            cost_estimate=dq_cost,
+            interpret=interpret,
+            **kwargs4,
+        )(q, k, v, do, lse, dvec)
+
+    dkv_cost = pl.CostEstimate(
+        # 4 matmuls per (q,k) tile pair: s, p^T@dO, dO@v^T, ds^T@q
+        flops=8 * bkv * g * tq * tk * d,
+        bytes_accessed=(q.size + k.size + v.size + do.size)
+        * q.dtype.itemsize,
+        transcendentals=bkv * g * tq * tk)
+    if tq <= _RESIDENT_MAX:
+        # dk/dv accumulate over the group inside the kernel; for g > 1
+        # the running sum lives in the output block, so keep it f32 and
+        # cast after (bf16 += per group head would round g times)
+        kv_acc_dtype = k.dtype if g == 1 else jnp.float32
+        dk, dv = pl.pallas_call(
+            functools.partial(_fa_bwd_dkv_kernel_res, causal=causal,
+                              scale=scale, block_q=block_q,
+                              offset=tk - tq),
+            grid=(bkv, pl.cdiv(tk, block_k), g),
+            in_specs=[
+                pl.BlockSpec((1, 1, tq, d), lambda b, i, gi: (b, gi, 0, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, gi: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, gi: (b, i, 0)),
+                pl.BlockSpec((1, 1, tq, d), lambda b, i, gi: (b, gi, 0, 0)),
+                pl.BlockSpec((1, 1, 1, tq), lambda b, i, gi: (b, gi, 0, 0)),
+                pl.BlockSpec((1, 1, 1, tq), lambda b, i, gi: (b, gi, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda b, i, gi: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, gi: (b, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bkv, tk, d), kv_acc_dtype),
+                jax.ShapeDtypeStruct((bkv, tk, d), kv_acc_dtype),
+            ],
+            cost_estimate=dkv_cost,
+            interpret=interpret,
+            **kwargs3,
+        )(q, k, v, do, lse, dvec)
+        return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+    if pltpu is None:  # pragma: no cover
+        raise RuntimeError("pallas TPU backend unavailable")
+    super_q, num_q_super = _split_super(tq, block_q)
+    # causal: q superblocks strictly above this k block's diagonal are
+    # fully masked; clamp their index so the dead steps re-address the
+    # previous superblock (no DMA) while the kernel skips their compute
+    if causal:
+        def q_idx(b, i, gi, qsi):
+            lo = jax.lax.div(jax.lax.max(i * block_k - (tk - tq), 0),
+                             super_q)
+            return (b, gi, jnp.maximum(qsi, lo), 0)
+
+        def qrow_idx(b, i, gi, qsi):
+            lo = jax.lax.div(jax.lax.max(i * block_k - (tk - tq), 0),
+                             super_q)
+            return (b, gi, 0, jnp.maximum(qsi, lo))
+    else:
+        q_idx = lambda b, i, gi, qsi: (b, gi, qsi, 0)      # noqa: E731
+        qrow_idx = lambda b, i, gi, qsi: (b, gi, 0, qsi)   # noqa: E731
     dk, dv = pl.pallas_call(
-        functools.partial(_fa_bwd_dkv_kernel, causal=causal, scale=scale,
-                          block_q=block_q, offset=tk - tq),
-        grid=(bkv, pl.cdiv(tk, block_k), g),
+        functools.partial(_fa_bwd_dkv_kernel_stream, causal=causal,
+                          scale=scale, block_q=block_q, offset=tk - tq,
+                          g=g, num_q_super=num_q_super),
+        grid=(bkv, pl.cdiv(tk, block_k), g, num_q_super),
         in_specs=[
-            pl.BlockSpec((1, 1, tq, d), lambda b, i, gi: (b, gi, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, gi: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, gi: (b, i, 0)),
-            pl.BlockSpec((1, 1, tq, d), lambda b, i, gi: (b, gi, 0, 0)),
-            pl.BlockSpec((1, 1, 1, tq), lambda b, i, gi: (b, gi, 0, 0)),
-            pl.BlockSpec((1, 1, 1, tq), lambda b, i, gi: (b, gi, 0, 0)),
+            pl.BlockSpec((1, 1, super_q, d), q_idx),
+            pl.BlockSpec((1, block_k, d), lambda b, i, gi, qsi: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, gi, qsi: (b, i, 0)),
+            pl.BlockSpec((1, 1, super_q, d), q_idx),
+            pl.BlockSpec((1, 1, 1, super_q), qrow_idx),
+            pl.BlockSpec((1, 1, 1, super_q), qrow_idx),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, i, gi: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, gi: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, gi, qsi: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, gi, qsi: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bkv, tk, d), kv_acc_dtype),
-            jax.ShapeDtypeStruct((bkv, tk, d), kv_acc_dtype),
+            jax.ShapeDtypeStruct((bkv, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bkv, tk, d), v.dtype),
         ],
-        cost_estimate=pl.CostEstimate(
-            # 4 matmuls per (q,k) tile pair: s, p^T@dO, dO@v^T, ds^T@q
-            flops=8 * bkv * g * tq * tk * d,
-            bytes_accessed=(q.size + k.size + v.size + do.size)
-            * q.dtype.itemsize,
-            transcendentals=bkv * g * tq * tk),
+        # dk/dv accumulate over the group AND all q superblocks in f32
+        # scratch (a bf16 += per contribution would round many times);
+        # single cast at the final flush
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        cost_estimate=dkv_cost,
         interpret=interpret,
-        **kwargs,
+        **kwargs4,
     )(q, k, v, do, lse, dvec)
-    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+    return dq, dk, dv
 
 
 def _aligned(t, block):
@@ -431,6 +879,11 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
 
     # kernel_qualifies = the correctness contract; MIN_SEQ = the measured
     # perf threshold (auto mode only)
+    if pltpu is None and (tq > _RESIDENT_MAX or tk > _RESIDENT_MAX):
+        # the streaming kernels carry state in pltpu.VMEM scratch (both
+        # compiled and interpret mode) — without the TPU pallas backend,
+        # XLA path
+        return fallback()
     if interpret is None:
         if not (on_tpu()
                 and kernel_qualifies(tq, tk, d, causal=causal)

@@ -485,3 +485,53 @@ def test_pallas_flash_causal_cross_length_matches_xla():
     assert np.isfinite(np.asarray(got)).all()
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_pallas_flash_streaming_regime_matches_xla(monkeypatch):
+    """The streaming kernels (seq > _RESIDENT_MAX: K/V — and Q in the
+    dkv kernel — cross the grid one superblock at a time with the
+    online-softmax / gradient carry in VMEM scratch) must agree with the
+    XLA reference exactly like the resident ones. _RESIDENT_MAX and
+    SUPER_TARGET are forced down so CI-sized shapes cross the boundary
+    and every superblock case runs: multiple supersteps, GQA group
+    accumulation, causal superstep skipping, and the tq != tk offset."""
+    from mxnet_tpu.ops.pallas import flash_attention as fa
+    from mxnet_tpu.ops.attention import _grouped_attention
+    from mxnet_tpu.ops.attention import dot_product_attention
+
+    # without the TPU pallas backend flash_attention falls back to the
+    # XLA path for streaming shapes and this test would compare the
+    # reference against itself
+    assert fa.pltpu is not None, "pltpu missing; streaming path untestable"
+    monkeypatch.setattr(fa, "_RESIDENT_MAX", 256)
+    monkeypatch.setattr(fa, "SUPER_TARGET", 512)
+    rng = np.random.RandomState(13)
+    B, D = 1, 8
+    # (h, hkv, tq, tk, causal): all > 256 shapes take the streaming path
+    cases = ((2, 2, 1024, 1024, True),    # 2 supersteps, causal skip
+             (2, 2, 1024, 1024, False),
+             (4, 2, 512, 1024, True),     # GQA + offset + streaming
+             (2, 2, 512, 512, True))      # single superstep boundary
+    for h, hkv, tq, tk, causal in cases:
+        q = jnp.asarray(rng.randn(B, h, tq, D).astype(np.float32))
+        k = jnp.asarray(rng.randn(B, hkv, tk, D).astype(np.float32))
+        v = jnp.asarray(rng.randn(B, hkv, tk, D).astype(np.float32))
+
+        def ref(q, k, v, causal=causal, hkv=hkv):
+            if hkv != q.shape[1]:
+                return _grouped_attention(q, k, v, hkv, causal)
+            return dot_product_attention(q, k, v, causal=causal)
+
+        got = fa.flash_attention(q, k, v, causal=causal, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref(q, k, v)), rtol=2e-4,
+            atol=2e-4, err_msg="fwd %s" % ((h, hkv, tq, tk, causal),))
+        gf = jax.grad(lambda q, k, v: jnp.sum(fa.flash_attention(
+            q, k, v, causal=causal, interpret=True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gp = jax.grad(lambda q, k, v: jnp.sum(ref(q, k, v) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gf, gp):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=5e-4,
+                err_msg="%s %s" % (name, (h, hkv, tq, tk, causal)))
