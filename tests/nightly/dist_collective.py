@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Multi-process COLLECTIVE-mode validation (the jax.distributed leg of
+SURVEY §5.8, beside the PS leg dist_sync_kvstore.py covers): N OS
+processes launched by tools/launch.py assemble one global backend via
+`dist.init()` (coordinator env + gloo CPU collectives) and must see each
+other — process_count == N and a cross-process allgather returning every
+rank's contribution in rank order.
+
+Run by tests/test_dist_multiprocess.py as:
+    python tools/launch.py -n 2 --launcher local python this.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    from mxnet_tpu.parallel import dist
+
+    dist.init()
+    n = int(os.environ["MXNET_TPU_NUM_PROCS"])
+    assert dist.size() == n, (dist.size(), n)
+    g = np.asarray(multihost_utils.process_allgather(
+        jnp.array([dist.rank() + 1.0])))
+    want = np.arange(1, n + 1, dtype=np.float32)
+    assert np.array_equal(g.ravel(), want), (g, want)
+    dist.barrier()
+    print("rank %d/%d collective OK" % (dist.rank(), dist.size()),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

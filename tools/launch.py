@@ -15,9 +15,22 @@ Modes:
                     multi-process CPU emulation or one-host multi-chip)
   --launcher ssh    one process per host listed in --hostfile
                     (the dmlc "ssh" tracker)
+  --launcher mpi    delegate process placement to mpirun; per-rank
+                    identity comes from the MPI env (OMPI_COMM_WORLD_* /
+                    PMI_*) which dist.init() reads once the launcher has
+                    pinned the coordinator (the dmlc "mpi" tracker)
+  --launcher sge    submit a qsub array job whose tasks derive their rank
+                    from SGE_TASK_ID (the dmlc "sge" tracker)
+  --launcher yarn   print the YARN distributed-shell submission with the
+                    coordinator env wired (the dmlc "yarn" tracker; like
+                    the tpu mode, cluster submission runs via the
+                    cluster's own CLI)
   --launcher tpu    print the gcloud command that runs the script on every
                     worker of a TPU pod slice (pods launch via the cloud
                     CLI, not raw ssh)
+
+--dry-run prints the exact command/script any launcher would run without
+executing it.
 
 Example:
   python tools/launch.py -n 4 --launcher local python train.py --epochs 1
@@ -27,6 +40,17 @@ import os
 import shlex
 import subprocess
 import sys
+
+
+def _coord(host="127.0.0.1"):
+    """coordinator address `host:port` — the one place the default port
+    and MXNET_TPU_PORT override live."""
+    return "%s:%d" % (host, int(os.environ.get("MXNET_TPU_PORT", "12975")))
+
+
+def _read_hostfile(path):
+    with open(path) as f:
+        return [h.strip().split()[0] for h in f if h.strip()]
 
 
 def launch_local(n, cmd, env_extra=None, n_servers=0):
@@ -39,7 +63,7 @@ def launch_local(n, cmd, env_extra=None, n_servers=0):
 
     procs = []
     servers = []
-    coord = "127.0.0.1:%d" % int(os.environ.get("MXNET_TPU_PORT", "12975"))
+    coord = _coord()
     ps_uri = None
     if n_servers > 0:
         ports = []
@@ -123,8 +147,7 @@ def launch_local(n, cmd, env_extra=None, n_servers=0):
 
 def launch_ssh(hosts, cmd, repo_dir):
     """One process per host over ssh (dmlc ssh tracker analogue)."""
-    coord = "%s:%d" % (hosts[0], int(os.environ.get("MXNET_TPU_PORT",
-                                                    "12975")))
+    coord = _coord(hosts[0])
     procs = []
     for rank, host in enumerate(hosts):
         envs = ("MXNET_TPU_COORDINATOR=%s MXNET_TPU_NUM_PROCS=%d "
@@ -139,6 +162,116 @@ def launch_ssh(hosts, cmd, repo_dir):
         p.wait()
         rc = rc or p.returncode
     return rc
+
+
+def _mpi_env_flags(var, value):
+    """mpirun flags exporting var=value to every rank, in the installed
+    MPI's dialect: OpenMPI takes `-x VAR=val`, MPICH/hydra and Intel MPI
+    take `-genv VAR val` (hydra aborts on an unknown `-x`). Flavor is
+    sniffed from `mpirun --version`; unknown/absent mpirun defaults to
+    the OpenMPI form."""
+    flavor = ""
+    try:
+        out = subprocess.run(["mpirun", "--version"], capture_output=True,
+                             text=True, timeout=10)
+        flavor = (out.stdout or "") + (out.stderr or "")
+    except (FileNotFoundError, subprocess.TimeoutExpired):
+        pass
+    if "HYDRA" in flavor or "Intel" in flavor or "MPICH" in flavor:
+        return ["-genv", var, value]
+    return ["-x", "%s=%s" % (var, value)]
+
+
+def launch_mpi(n, cmd, hostfile=None, dry_run=False):
+    """Delegate placement to mpirun (dmlc mpi tracker analogue,
+    reference tools/launch.py:33-60). mpirun exports per-rank identity
+    (OMPI_COMM_WORLD_RANK/SIZE or PMI_RANK/SIZE) which
+    `mxnet_tpu.parallel.dist.init()` reads; the launcher's job is only
+    to pin the coordinator address every rank should dial."""
+    host = "127.0.0.1"
+    if hostfile:
+        hosts = _read_hostfile(hostfile)
+        if hosts:
+            host = hosts[0]
+    coord = _coord(host)
+    mpi_cmd = ["mpirun", "-np", str(n)]
+    if hostfile:
+        mpi_cmd += ["--hostfile", hostfile]
+    mpi_cmd += _mpi_env_flags("MXNET_TPU_COORDINATOR", coord) + cmd
+    if dry_run:
+        print(" ".join(shlex.quote(c) for c in mpi_cmd))
+        return 0
+    env = dict(os.environ, MXNET_TPU_COORDINATOR=coord)
+    try:
+        return subprocess.call(mpi_cmd, env=env)
+    except FileNotFoundError:
+        sys.stderr.write("launch.py: mpirun not found on PATH\n")
+        return 127
+
+
+def sge_job_script(n, cmd):
+    """The qsub array-job script text: N tasks, rank = SGE_TASK_ID - 1
+    (dist.init reads SGE_TASK_ID/FIRST/STEPSIZE/LAST), coordinator on
+    the submit host — resolved NOW, at generation time: a shell
+    $(hostname) would expand per-task on each execution host and every
+    rank would dial a different address."""
+    import socket
+
+    coord = _coord(os.environ.get("MXNET_TPU_COORD_HOST")
+                   or socket.getfqdn())
+    joined = " ".join(shlex.quote(c) for c in cmd)
+    return "\n".join([
+        "#!/bin/bash",
+        "#$ -cwd",
+        "#$ -t 1-%d" % n,
+        "#$ -S /bin/bash",
+        "export MXNET_TPU_COORDINATOR=%s" % coord,
+        joined,
+        "",
+    ])
+
+
+def launch_sge(n, cmd, dry_run=False):
+    """Submit the array job via qsub (dmlc sge tracker analogue)."""
+    script = sge_job_script(n, cmd)
+    if dry_run:
+        print(script)
+        return 0
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".sh", delete=False) as f:
+        f.write(script)
+        path = f.name
+    try:
+        return subprocess.call(["qsub", "-sync", "y", path])
+    except FileNotFoundError:
+        sys.stderr.write("launch.py: qsub not found on PATH\n")
+        return 127
+
+
+def launch_yarn(n, cmd):
+    """Print the YARN distributed-shell submission (dmlc yarn tracker
+    analogue). Like the tpu mode, the cluster's own CLI performs the
+    submission. Rank identity: the distributed-shell exports no task
+    index, but every container's CONTAINER_ID ends in a dense 1-based
+    ordinal where _000001 is the application master — worker rank =
+    ordinal - 2."""
+    coord = _coord(os.environ.get("MXNET_TPU_COORD_HOST")
+                   or "$COORD_HOST")
+    joined = " ".join(shlex.quote(c) for c in cmd)
+    shell = ("export MXNET_TPU_PROC_ID=$(( 10#${CONTAINER_ID##*_} - 2 )); "
+             + joined)
+    print("# Submit via the YARN distributed-shell application:")
+    print("yarn jar $HADOOP_HOME/share/hadoop/yarn/"
+          "hadoop-yarn-applications-distributedshell-*.jar "
+          "-jar $HADOOP_HOME/share/hadoop/yarn/"
+          "hadoop-yarn-applications-distributedshell-*.jar "
+          "-num_containers %d "
+          "-shell_env MXNET_TPU_COORDINATOR=%s "
+          "-shell_env MXNET_TPU_NUM_PROCS=%d "
+          "-shell_command %s"
+          % (n, coord, n, shlex.quote(shell)))
+    return 0
 
 
 def launch_tpu_pod(args, cmd):
@@ -159,10 +292,14 @@ def main():
     ap.add_argument("-n", "--num-workers", type=int, default=1)
     ap.add_argument("-s", "--num-servers", type=int, default=0,
                     help="parameter-server processes (local launcher)")
-    ap.add_argument("--launcher", choices=["local", "ssh", "tpu"],
+    ap.add_argument("--launcher",
+                    choices=["local", "ssh", "mpi", "sge", "yarn", "tpu"],
                     default="local")
-    ap.add_argument("--hostfile", help="one host per line (ssh launcher)")
+    ap.add_argument("--hostfile",
+                    help="one host per line (ssh/mpi launchers)")
     ap.add_argument("--tpu-name", help="TPU pod name (tpu launcher)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print what would run without executing")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.command:
@@ -178,6 +315,13 @@ def main():
             hosts = [h.strip() for h in f if h.strip()]
         sys.exit(launch_ssh(hosts[:args.num_workers] if args.num_workers > 1
                             else hosts, cmd, os.getcwd()))
+    elif args.launcher == "mpi":
+        sys.exit(launch_mpi(args.num_workers, cmd, hostfile=args.hostfile,
+                            dry_run=args.dry_run))
+    elif args.launcher == "sge":
+        sys.exit(launch_sge(args.num_workers, cmd, dry_run=args.dry_run))
+    elif args.launcher == "yarn":
+        sys.exit(launch_yarn(args.num_workers, cmd))
     else:
         sys.exit(launch_tpu_pod(args, cmd))
 
