@@ -206,3 +206,11 @@ def test_profiler_example():
     """profiler_set_config/state bracketing writes a non-empty trace."""
     out = _run("examples/profiler/profiler_matmul.py", "--iters", "10")
     assert "profiler OK" in out
+
+
+def test_dec_example():
+    """DEC: autoencoder pretrain -> k-means center init -> symbolic
+    Student-t soft assignment + MakeLoss KL refinement with trainable
+    centers; Hungarian-matched cluster accuracy."""
+    out = _run("examples/dec/dec.py", "--steps", "60")
+    assert "dec OK" in out
