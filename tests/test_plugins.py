@@ -216,3 +216,112 @@ def test_caffemodel_v1_layer_format():
     assert layers[0]["name"] == "old_ip"
     assert layers[0]["type"] == "InnerProduct"
     np.testing.assert_array_equal(layers[0]["blobs"][0], w)
+
+
+def test_caffe_plugin_executes_with_contract_stub(monkeypatch):
+    """Run the caffe plugin's ACTUAL code (prototxt assembly, Net blob
+    marshaling, forward/backward through the Custom bridge) against a
+    pycaffe-contract stub implementing a ReLU layer — so the plugin file
+    is executed code, not an import-gated shim, even without caffe. The
+    stub mirrors the pycaffe surface the plugin touches: caffe.Net(path,
+    phase), net.blobs OrderedDict of blob.data/.diff/.reshape, forward()
+    and backward()."""
+    import collections
+    import sys
+    import types
+
+    class _Blob:
+        def __init__(self, shape):
+            self.data = np.zeros(shape, np.float32)
+            self.diff = np.zeros(shape, np.float32)
+
+        def reshape(self, *shape):
+            self.data = np.zeros(shape, np.float32)
+            self.diff = np.zeros(shape, np.float32)
+
+    class _Net:
+        def __init__(self, path, phase):
+            text = open(path).read()
+            # the plugin must declare the input and force diffs
+            assert 'input: "data"' in text
+            assert "force_backward: true" in text
+            assert 'type: "ReLU"' in text  # the user layer made it in
+            import re
+            dims = [int(d) for d in re.findall(r"dim:\s*(\d+)", text)]
+            self.blobs = collections.OrderedDict(
+                [("data", _Blob(tuple(dims))),
+                 ("relu1", _Blob(tuple(dims)))])
+
+        def forward(self):
+            # real pycaffe reshapes top blobs (data AND diff) on forward
+            self.blobs["relu1"].reshape(*self.blobs["data"].data.shape)
+            self.blobs["relu1"].data = np.maximum(
+                self.blobs["data"].data, 0)
+
+        def backward(self):
+            self.blobs["data"].diff = (
+                self.blobs["relu1"].diff
+                * (self.blobs["data"].data > 0))
+
+    fake = types.ModuleType("caffe")
+    fake.Net = _Net
+    fake.TEST = 1
+    monkeypatch.setitem(sys.modules, "caffe", fake)
+    # layer_op registers globally with a closure over the fake module;
+    # drop the entry on teardown so later tests can't hit the stub
+    from mxnet_tpu.operator import _CUSTOM_OP_REGISTRY
+    monkeypatch.setitem(_CUSTOM_OP_REGISTRY, "caffe_relu_stub", None)
+
+    mx.plugins.caffe.layer_op(
+        'layer { name: "relu1" type: "ReLU" bottom: "data" top: "relu1" }',
+        "caffe_relu_stub", input_shape=(2, 3, 4, 4))
+    x = np.random.RandomState(0).randn(2, 3, 4, 4).astype(np.float32)
+    from mxnet_tpu import autograd
+    xa = mx.nd.array(x)
+    xa.attach_grad()
+    with autograd.record():
+        y = mx.nd.Custom(xa, op_type="caffe_relu_stub")
+        loss = (y * y).sum()
+    loss.backward()
+    np.testing.assert_allclose(y.asnumpy(), np.maximum(x, 0), rtol=1e-6)
+    np.testing.assert_allclose(xa.grad.asnumpy(),
+                               2 * np.maximum(x, 0) * (x > 0), rtol=1e-5)
+
+
+def test_sframe_iter_executes_with_contract_stub():
+    """Drive SFrameIter's real batching/stacking code with an SFrame-
+    contract stub (column __getitem__ + to_numpy marker): multi-field
+    stacking, label column, batch shapes/values, reset, tail drop."""
+
+    class _FakeSFrame:
+        def __init__(self, cols):
+            self._cols = cols
+
+        def to_numpy(self):  # the SFrame-likeness marker the iter checks
+            raise NotImplementedError
+
+        def __getitem__(self, name):
+            return self._cols[name]
+
+    n = 10
+    rng = np.random.RandomState(1)
+    sf = _FakeSFrame({
+        "f1": [rng.rand(3).astype(np.float32) for _ in range(n)],
+        "f2": list(np.arange(n, dtype=np.float32)),
+        "y": list((np.arange(n) % 2).astype(np.float32)),
+    })
+    it = mx.plugins.sframe.SFrameIter(sf, ["f1", "f2"], label_field="y",
+                                      batch_size=4)
+    assert it.provide_data[0].shape == (4, 4)   # 3 (f1) + 1 (f2) stacked
+    assert it.provide_label[0].shape == (4,)
+    batches = list(it)
+    assert len(batches) == 2                    # 10 // 4, tail dropped
+    b0 = batches[0]
+    np.testing.assert_allclose(b0.data[0].asnumpy()[:, 3],
+                               np.arange(4, dtype=np.float32))
+    np.testing.assert_allclose(b0.label[0].asnumpy(),
+                               np.arange(4) % 2)
+    it.reset()
+    again = list(it)
+    np.testing.assert_array_equal(again[0].data[0].asnumpy(),
+                                  b0.data[0].asnumpy())
