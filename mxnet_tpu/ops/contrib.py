@@ -446,8 +446,13 @@ def _correlation(attrs, data1, data2):
     p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
     p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
     ph, pw = h + 2 * pad, w + 2 * pad
-    # valid center positions: [border, size - border) stepped by stride1
-    border = max(md, rad)
+    # valid center positions: [border, size - border) stepped by stride1;
+    # the border must fit displacement AND kernel radius TOGETHER — the
+    # displaced patch extends to center + md + rad (reference
+    # correlation-inl.h kernel_radius_ + max_displacement_ border; using
+    # max(md, rad) both mis-sized the output for kernel_size > 1 and let
+    # edge windows read clamped out-of-range values)
+    border = md + rad
     ys = list(range(border, ph - border, s1))
     xs = list(range(border, pw - border, s1))
     out_h, out_w = len(ys), len(xs)

@@ -682,14 +682,23 @@ def _make_loss(attrs, data):
         return d
 
     def op_fwd(d):
-        # batch size for normalization="batch"; scalar losses have none
-        return d, (d.shape[0] if d.ndim else 1)
+        # batch size for normalization="batch"; "valid" needs the data
+        # itself (count of entries above valid_thresh, make_loss-inl.h:84)
+        batch = d.shape[0] if d.ndim else 1
+        res = d if attrs["normalization"] == "valid" else None
+        return d, (batch, res)
 
-    def op_bwd(batch, g):
+    def op_bwd(residuals, g):
+        batch, d = residuals
         scale = attrs["grad_scale"]
         if attrs["normalization"] == "batch":
             scale = scale / batch
-        return (jnp.full_like(g, scale),)
+        grad = jnp.full_like(g, scale)
+        if attrs["normalization"] == "valid":
+            valid = jnp.maximum(
+                jnp.sum((d > attrs["valid_thresh"]).astype(g.dtype)), 1.0)
+            grad = grad / valid
+        return (grad,)
 
     op.defvjp(op_fwd, op_bwd)
     return op(data)

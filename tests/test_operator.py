@@ -1159,3 +1159,217 @@ def test_batch_take_and_argmax_channel():
     am = sym.argmax_channel(sym.Variable("data"))
     check_symbolic_forward(am, {"data": x},
                            [np.array([3., 3., 3.], np.float32)])
+
+
+# --- tranche 3: reference long-tail cases ----------------------------------
+
+def _np_correlation(d1, d2, kernel_size, max_displacement, stride1,
+                    stride2, pad_size, is_multiply):
+    """Direct numpy model of the reference Correlation op
+    (src/operator/correlation-inl.h): pad both inputs, slide a
+    kernel_size patch over stride1 grid positions on data1, compare with
+    data2 patches displaced on a stride2 grid within max_displacement,
+    output channel per displacement, normalized by patch size."""
+    n, c, h, w = d1.shape
+    p1 = np.zeros((n, c, h + 2 * pad_size, w + 2 * pad_size), d1.dtype)
+    p2 = np.zeros_like(p1)
+    p1[:, :, pad_size:pad_size + h, pad_size:pad_size + w] = d1
+    p2[:, :, pad_size:pad_size + h, pad_size:pad_size + w] = d2
+    kr = kernel_size // 2
+    bd = max_displacement // stride2
+    nd = 2 * bd + 1
+    paddedh, paddedw = h + 2 * pad_size, w + 2 * pad_size
+    kernel_radius_aligned = kr + max_displacement
+    out_h = int(np.ceil((paddedh - 2 * kernel_radius_aligned) / stride1))
+    out_w = int(np.ceil((paddedw - 2 * kernel_radius_aligned) / stride1))
+    out = np.zeros((n, nd * nd, out_h, out_w), np.float32)
+    sumelems = kernel_size * kernel_size * c
+    for b in range(n):
+        for i in range(out_h):
+            for j in range(out_w):
+                y1 = i * stride1 + kernel_radius_aligned
+                x1 = j * stride1 + kernel_radius_aligned
+                for tj in range(-bd, bd + 1):
+                    for ti in range(-bd, bd + 1):
+                        ch = (tj + bd) * nd + (ti + bd)
+                        y2 = y1 + tj * stride2
+                        x2 = x1 + ti * stride2
+                        patch1 = p1[b, :, y1 - kr:y1 + kr + 1,
+                                    x1 - kr:x1 + kr + 1]
+                        patch2 = p2[b, :, y2 - kr:y2 + kr + 1,
+                                    x2 - kr:x2 + kr + 1]
+                        if is_multiply:
+                            v = (patch1 * patch2).sum()
+                        else:
+                            v = np.abs(patch1 - patch2).sum()
+                        out[b, ch, i, j] = v / sumelems
+    return out
+
+
+def test_correlation_vs_numpy():
+    """Reference test_operator.py:1715-1725 config sweep (FlowNet
+    Correlation): displacement grids, stride1/stride2, multiply vs
+    absolute-difference mode, odd input sizes."""
+    rng = np.random.RandomState(0)
+    configs = [
+        ((1, 3, 10, 10), 1, 4, 1, 1, 4, False),
+        ((2, 1, 15, 15), 1, 5, 1, 1, 5, False),
+        ((2, 1, 15, 15), 1, 5, 1, 1, 5, True),
+        ((2, 1, 15, 15), 1, 10, 1, 2, 10, True),
+        ((2, 1, 4, 4), 3, 1, 1, 1, 2, True),
+        ((2, 1, 4, 4), 3, 1, 2, 1, 2, True),
+        ((2, 1, 4, 4), 3, 1, 2, 1, 2, False),
+        ((2, 1, 6, 4), 3, 1, 2, 1, 2, False),
+    ]
+    for shape, ks, md, s1, s2, ps, mult in configs:
+        a = rng.randn(*shape).astype(np.float32)
+        b = rng.randn(*shape).astype(np.float32)
+        got = nd.Correlation(nd.array(a), nd.array(b), kernel_size=ks,
+                             max_displacement=md, stride1=s1, stride2=s2,
+                             pad_size=ps, is_multiply=mult).asnumpy()
+        want = _np_correlation(a, b, ks, md, s1, s2, ps, mult)
+        np.testing.assert_allclose(
+            got, want, rtol=1e-4, atol=1e-4,
+            err_msg="corr %s" % ((shape, ks, md, s1, s2, ps, mult),))
+
+
+def test_flip_reverse():
+    """reference test_operator.py:1429 flip + reverse multi-axis."""
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    np.testing.assert_array_equal(
+        nd.flip(nd.array(x), axis=1).asnumpy(), x[:, ::-1, :])
+    np.testing.assert_array_equal(
+        nd.reverse(nd.array(x), axis=(0, 2)).asnumpy(), x[::-1, :, ::-1])
+    # gradient: reversal is its own adjoint
+    s = sym.reverse(sym.Variable("data"), axis=(1,))
+    exe = s.simple_bind(mx.cpu(), data=(2, 3, 4), grad_req="write")
+    exe.arg_dict["data"][:] = x
+    exe.forward(is_train=True)
+    g = np.arange(24, dtype=np.float32).reshape(2, 3, 4) + 1
+    exe.backward([nd.array(g)])
+    np.testing.assert_array_equal(exe.grad_dict["data"].asnumpy(),
+                                  g[:, ::-1, :])
+
+
+def test_batch_dot_transpose_combos():
+    """reference test_operator.py:1532: all four transpose combinations,
+    forward vs numpy einsum and gradients vs numeric."""
+    rng = np.random.RandomState(3)
+    B, M, K, N = 3, 4, 5, 6
+    for ta in (False, True):
+        for tb in (False, True):
+            ash = (B, K, M) if ta else (B, M, K)
+            bsh = (B, N, K) if tb else (B, K, N)
+            a = rng.randn(*ash).astype(np.float32)
+            b = rng.randn(*bsh).astype(np.float32)
+            am = a.transpose(0, 2, 1) if ta else a
+            bm = b.transpose(0, 2, 1) if tb else b
+            want = np.einsum("bmk,bkn->bmn", am, bm)
+            got = nd.batch_dot(nd.array(a), nd.array(b), transpose_a=ta,
+                               transpose_b=tb).asnumpy()
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5,
+                                       err_msg="ta=%s tb=%s" % (ta, tb))
+            s = sym.batch_dot(sym.Variable("a"), sym.Variable("b"),
+                              transpose_a=ta, transpose_b=tb)
+            check_numeric_gradient(s, {"a": a, "b": b}, rtol=1e-2,
+                                   atol=1e-3)
+
+
+def test_dropout_modes():
+    """Dropout semantics (reference test_operator.py dropout section):
+    inverted scaling at train time (kept values divided by 1-p), identity
+    at inference, mask shared between output and gradient."""
+    p = 0.4
+    x = np.ones((200, 200), np.float32)
+    s = sym.Dropout(sym.Variable("data"), p=p)
+    exe = s.simple_bind(mx.cpu(), data=x.shape, grad_req="write")
+    exe.arg_dict["data"][:] = x
+    out = exe.forward(is_train=True)[0].asnumpy()
+    kept = out != 0
+    # inverted dropout: surviving entries scaled by 1/(1-p)
+    np.testing.assert_allclose(out[kept], 1.0 / (1 - p), rtol=1e-5)
+    assert abs(kept.mean() - (1 - p)) < 0.05
+    # backward uses the SAME mask and scale
+    exe.backward([nd.array(np.ones_like(x))])
+    g = exe.grad_dict["data"].asnumpy()
+    np.testing.assert_allclose(g, kept * (1.0 / (1 - p)), rtol=1e-5)
+    # inference: identity
+    out_inf = exe.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out_inf, x, rtol=1e-6)
+
+
+def test_softmax_activation_modes():
+    """SoftmaxActivation instance vs channel mode (reference
+    softmax_activation-inl.h): channel softmaxes over dim 1 per spatial
+    position."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 3, 4, 4).astype(np.float32)
+
+    def np_softmax(v, axis):
+        e = np.exp(v - v.max(axis=axis, keepdims=True))
+        return e / e.sum(axis=axis, keepdims=True)
+
+    inst = nd.SoftmaxActivation(nd.array(x.reshape(2, -1))).asnumpy()
+    np.testing.assert_allclose(inst, np_softmax(x.reshape(2, -1), 1),
+                               rtol=1e-5)
+    chan = nd.SoftmaxActivation(nd.array(x), mode="channel").asnumpy()
+    np.testing.assert_allclose(chan, np_softmax(x, 1), rtol=1e-5)
+    np.testing.assert_allclose(chan.sum(axis=1), np.ones((2, 4, 4)),
+                               rtol=1e-5)
+
+
+def test_makeloss_normalization_and_scale():
+    """MakeLoss grad_scale / valid_thresh / normalization (reference
+    make_loss-inl.h): the head gradient of the wrapped expression is
+    grad_scale (per element), divided by batch under 'batch' and by the
+    count of entries STRICTLY > valid_thresh under 'valid' (the reference
+    mshadow threshold op)."""
+    x = np.array([[0.0, 2.0], [3.0, 0.0]], np.float32)
+
+    def head_grad(**kw):
+        s = sym.MakeLoss(sym.Variable("data") * 2.0, **kw)
+        exe = s.simple_bind(mx.cpu(), data=x.shape, grad_req="write")
+        exe.arg_dict["data"][:] = x
+        exe.forward(is_train=True)
+        exe.backward()
+        return exe.grad_dict["data"].asnumpy()
+
+    np.testing.assert_allclose(head_grad(), np.full_like(x, 2.0))
+    np.testing.assert_allclose(head_grad(grad_scale=3.0),
+                               np.full_like(x, 6.0))
+    np.testing.assert_allclose(head_grad(normalization="batch"),
+                               np.full_like(x, 2.0 / 2))
+    # valid: 2*x has entries [0,4,6,0]; > thresh 1.0 -> 2 valid
+    np.testing.assert_allclose(
+        head_grad(normalization="valid", valid_thresh=1.0),
+        np.full_like(x, 2.0 / 2))
+
+
+def test_roipooling_boundaries():
+    """ROIPooling edge rois (reference test_operator.py:1786): rounding
+    via spatial_scale, rois clipped at the image border, degenerate
+    (single-cell) rois, and batch-index routing."""
+    h = w = 6
+    feat = np.arange(2 * 1 * h * w, dtype=np.float32).reshape(2, 1, h, w)
+    # (batch_idx, x1, y1, x2, y2) in image coords, spatial_scale 0.5
+    rois = np.array([[0, 0, 0, 11, 11],     # whole feature map (img 12x12)
+                     [1, 4, 4, 4, 4],       # degenerate single cell
+                     [0, 10, 10, 16, 16]],  # extends past border -> clip
+                    np.float32)
+    out = nd.ROIPooling(nd.array(feat), nd.array(rois),
+                        pooled_size=(2, 2), spatial_scale=0.5).asnumpy()
+    f0, f1 = feat[0, 0], feat[1, 0]
+    # Reference bin math (roi_pooling-inl.h): start = round(x1*scale),
+    # end = round(x2*scale), size = end - start + 1; bin edges
+    # floor(i*size/p)..ceil((i+1)*size/p), clipped to the feature map.
+    # roi0: start 0, end round(5.5)=6 -> size 7, bins rows/cols
+    # 0..4 and 3..6 (clipped) -> maxes at [3,3],[3,5],[5,3],[5,5]
+    np.testing.assert_allclose(
+        out[0, 0], [[f0[0:4, 0:4].max(), f0[0:4, 3:6].max()],
+                    [f0[3:6, 0:4].max(), f0[3:6, 3:6].max()]])
+    # roi1: start=end=2 -> size 1; every bin sees cell (2,2) of image 1
+    np.testing.assert_allclose(out[1, 0], np.full((2, 2), f1[2, 2]))
+    # roi2: start 5, end round(8)=8 -> bins past the border are EMPTY
+    # after clipping and emit 0 (reference is_empty branch); only the
+    # first bin survives with the corner cell
+    np.testing.assert_allclose(out[2, 0], [[f0[5, 5], 0.0], [0.0, 0.0]])
