@@ -322,12 +322,40 @@ def _lm_train_inner(args, use_flash, num_kv_heads, steps, quiet):
         mem = jax.devices()[0].memory_stats() or {}
     except Exception:
         pass
+    mfu = lm_mfu(sym, N, T, t)
     if not quiet:
         print("transformer-lm(flash=%s) L=%d dm=%d heads=%d vocab=%d bs=%d "
-              "seq=%d: %.2f ms/step  %.0f tokens/s"
+              "seq=%d: %.2f ms/step  %.0f tokens/s  %s"
               % (use_flash, args.num_layers, args.model_dim, args.num_heads,
-                 args.vocab, N, T, t * 1e3, N * T / t))
-    return t, mem
+                 args.vocab, N, T, t * 1e3, N * T / t, _mfu_str(mfu)))
+    return t, mem, mfu
+
+
+def lm_mfu(sym, batch, seq, step_s):
+    """Model FLOPs utilization of one training step: analytic matmul
+    FLOPs over the LM graph (flops.count_flops — FC projections + the
+    MultiHeadAttention node at its USEFUL causal count), 3x for the
+    training step, against the chip's nominal bf16 peak. Same guards as
+    bench.py's ResNet headline: None (not a number) on unknown chips and
+    for non-bf16 compute (the bf16 denominator would be wrong), and the
+    BENCH_PEAK_TFLOPS calibration override is honored."""
+    import jax
+    from mxnet_tpu import flops as _flops
+
+    if os.environ.get("BENCH_DTYPE", "bfloat16") != "bfloat16":
+        return None
+    fwd = _flops.count_flops(sym, data=(batch, seq),
+                             softmax_label=(batch, seq))["total"]
+    peak, _ = _flops.chip_peak_flops(jax.devices()[0])
+    if os.environ.get("BENCH_PEAK_TFLOPS"):
+        peak = float(os.environ["BENCH_PEAK_TFLOPS"]) * 1e12
+    if not peak:  # unknown chip (CPU smoke runs): no meaningful MFU
+        return None
+    return 100.0 * _flops.training_flops(fwd) / step_s / peak
+
+
+def _mfu_str(mfu):
+    return "MFU n/a" if mfu is None else "%.1f%% MFU" % mfu
 
 
 def long_context(args):
@@ -346,9 +374,9 @@ def long_context(args):
         args.seq_len = seq
         args.batch_size = 1
         try:
-            t, stats = lm_train(args, use_flash=True,
-                                num_kv_heads=kv_heads, remat=remat,
-                                steps=5, quiet=True)
+            t, stats, mfu = lm_train(args, use_flash=True,
+                                     num_kv_heads=kv_heads, remat=remat,
+                                     steps=5, quiet=True)
         except Exception as e:
             print("long-context seq=%d FAILED: %s: %s"
                   % (seq, type(e).__name__, str(e)[:120]))
@@ -360,7 +388,8 @@ def long_context(args):
         hbm = ("HBM %.2f/%.2f GB" % (used, limit) if limit
                else "HBM n/a (runtime exposes no memory_stats)")
         print("long-context seq=%d (bs1, remat, GQA hkv=%d): %.1f ms/step"
-              "  %.0f tokens/s  %s" % (seq, kv_heads, t * 1e3, seq / t, hbm))
+              "  %.0f tokens/s  %s  %s"
+              % (seq, kv_heads, t * 1e3, seq / t, _mfu_str(mfu), hbm))
     return rows
 
 
@@ -393,8 +422,8 @@ def main():
     if not args.skip_micro:
         micro(args)
     if not args.skip_train:
-        t_flash, _ = lm_train(args, use_flash=True)
-        t_plain, _ = lm_train(args, use_flash=False)
+        t_flash = lm_train(args, use_flash=True)[0]
+        t_plain = lm_train(args, use_flash=False)[0]
         print("flash-vs-plain in training: %.2fx" % (t_plain / t_flash))
 
 

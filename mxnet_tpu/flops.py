@@ -138,6 +138,20 @@ def _node_flops(opname, attrs, in_shapes, out_shape) -> float:
         ta = str(attrs.get("transpose_a", False)) in ("True", "true", "1")
         ka = int(a[-2]) if ta else int(a[-1])
         return 2.0 * _prod(out_shape) * ka
+    if opname == "MultiHeadAttention":
+        # two matmuls per head — scores (Tq·Tk·Dh) and weighted values —
+        # = 4·N·H·Tq·Tk·Dh; causal counts the USEFUL (unmasked) half,
+        # matching how the flash kernels skip it and how docs/perf.md
+        # credits attention micros. Projections are separate FC nodes.
+        q = in_shapes[0]
+        k = in_shapes[1] if len(in_shapes) > 1 else None
+        if q is None or k is None:
+            return 0.0
+        n, tq, dmq = int(q[0]), int(q[1]), int(q[2])
+        tk = int(k[1])
+        causal = str(attrs.get("causal", False)) in ("True", "true", "1")
+        f = 4.0 * n * tq * tk * dmq  # H·Dh == dmq (query width)
+        return f / 2.0 if causal else f
     if opname == "RNN":
         # fused multi-layer RNN: dominated by 8 gate matmuls per LSTM step
         # (4 gates x {input, hidden}). Use weight blob size as MAC count

@@ -1373,3 +1373,23 @@ def test_roipooling_boundaries():
     # after clipping and emit 0 (reference is_empty branch); only the
     # first bin survives with the corner cell
     np.testing.assert_allclose(out[2, 0], [[f0[5, 5], 0.0], [0.0, 0.0]])
+
+
+def test_flops_multi_head_attention_counting():
+    """flops.count_flops credits MultiHeadAttention with 4*N*Tq*Tk*dmq
+    (two matmuls per head), halved for causal — the term behind the LM
+    MFU numbers in docs/perf.md."""
+    from mxnet_tpu import flops as _flops
+
+    N, T, H, D = 2, 256, 4, 32
+    dm = H * D
+    q = sym.Variable("q")
+    k = sym.Variable("k")
+    v = sym.Variable("v")
+    for causal, factor in ((False, 1.0), (True, 0.5)):
+        a = sym.MultiHeadAttention(query=q, key=k, value=v, num_heads=H,
+                                   causal=causal)
+        got = _flops.count_flops(a, q=(N, T, dm), k=(N, T, dm),
+                                 v=(N, T, dm))["MultiHeadAttention"]
+        want = 4.0 * N * T * T * dm * factor
+        assert got == want, (causal, got, want)
