@@ -511,6 +511,7 @@ def test_pallas_flash_streaming_regime_matches_xla(monkeypatch):
     cases = ((2, 2, 1024, 1024, True),    # 2 supersteps, causal skip
              (2, 2, 1024, 1024, False),
              (4, 2, 512, 1024, True),     # GQA + offset + streaming
+             (4, 1, 512, 1024, True),     # MQA: whole-group accumulation
              (2, 2, 512, 512, True))      # single superstep boundary
     for h, hkv, tq, tk, causal in cases:
         q = jnp.asarray(rng.randn(B, h, tq, D).astype(np.float32))
