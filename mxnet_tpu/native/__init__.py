@@ -84,6 +84,14 @@ def _bind(lib: ctypes.CDLL) -> None:
         lib._mxtpu_has_aug = True
     except AttributeError:
         lib._mxtpu_has_aug = False
+    try:
+        lib.mxio_im2rec.restype = ctypes.c_int64
+        lib.mxio_im2rec.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        lib._mxtpu_has_im2rec = True
+    except AttributeError:
+        lib._mxtpu_has_im2rec = False
     lib.mxio_imgloader_next.restype = ctypes.c_int
     lib.mxio_imgloader_next.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
@@ -125,6 +133,24 @@ def aug_hsl(img: np.ndarray, dh: int, ds: int, dl: int) -> np.ndarray:
     lib.mxio_aug_hsl(out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
                      w, h, dh, ds, dl)
     return out
+
+
+def im2rec_pack(lst_path, root, rec_path, idx_path, resize=0, quality=95,
+                nthreads=4):
+    """Multithreaded .lst -> .rec/.idx packer (the reference's C++
+    tools/im2rec.cc). Returns the number of records written. Ordered
+    output: byte-identical regardless of thread count."""
+    lib = load()
+    if lib is None or not getattr(lib, "_mxtpu_has_im2rec", False):
+        raise RuntimeError("native io library unavailable (or too old "
+                           "for im2rec)")
+    n = lib.mxio_im2rec(str(lst_path).encode(), str(root).encode(),
+                        str(rec_path).encode(), str(idx_path).encode(),
+                        int(resize), int(quality), int(nthreads))
+    if n < 0:
+        raise IOError("mxio_im2rec failed (unreadable .lst or unwritable "
+                      "output paths)")
+    return int(n)
 
 
 class NativeRecordReader:

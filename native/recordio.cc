@@ -21,7 +21,10 @@
 #include <cstring>
 #include <cstdlib>
 #include <cmath>
+#include <algorithm>
 #include <atomic>
+#include <fstream>
+#include <iterator>
 #include <condition_variable>
 #include <mutex>
 #include <queue>
@@ -120,6 +123,41 @@ bool DecodeJpeg(const uint8_t* data, size_t len, std::vector<uint8_t>* out,
   }
   jpeg_finish_decompress(&cinfo);
   jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// encode RGB u8 -> jpeg bytes (libjpeg mem dest); false on failure
+bool EncodeJpeg(const uint8_t* rgb, int w, int h, int quality,
+                std::vector<uint8_t>* out) {
+  jpeg_compress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  unsigned char* mem = nullptr;
+  unsigned long mem_size = 0;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_compress(&cinfo);
+    free(mem);
+    return false;
+  }
+  jpeg_create_compress(&cinfo);
+  jpeg_mem_dest(&cinfo, &mem, &mem_size);
+  cinfo.image_width = w;
+  cinfo.image_height = h;
+  cinfo.input_components = 3;
+  cinfo.in_color_space = JCS_RGB;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, quality, TRUE);
+  jpeg_start_compress(&cinfo, TRUE);
+  while (cinfo.next_scanline < cinfo.image_height) {
+    JSAMPROW row =
+        const_cast<uint8_t*>(rgb + (size_t)cinfo.next_scanline * w * 3);
+    jpeg_write_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_compress(&cinfo);
+  jpeg_destroy_compress(&cinfo);
+  out->assign(mem, mem + mem_size);
+  free(mem);
   return true;
 }
 
@@ -486,6 +524,167 @@ struct ImgLoader {
   }
 };
 
+// ------------------------------------------------------------- im2rec ----
+// Multithreaded dataset packer (the reference's tools/im2rec.cc): read a
+// .lst index ("key\tlabel\t...\trelpath"), N workers load (and for
+// resize > 0, decode/shrink/re-encode) images, one ordered writer frames
+// IRHeader+bytes records and the .idx offsets. Ordering is preserved by a
+// bounded reorder window so output is byte-deterministic regardless of
+// thread timing.
+
+#pragma pack(push, 1)
+struct IRHeaderWire {  // python recordio.py _IR_FORMAT "IfQQ"
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+#pragma pack(pop)
+static_assert(sizeof(IRHeaderWire) == 24, "IRHeader wire layout");
+
+struct PackEntry {
+  uint64_t key;
+  float label;
+  std::string path;
+};
+
+int64_t Im2Rec(const char* lst_path, const char* root, const char* rec_path,
+               const char* idx_path, int resize, int quality, int nthreads) {
+  std::ifstream lst(lst_path);
+  if (!lst) return -1;
+  std::vector<PackEntry> entries;
+  std::string line;
+  while (std::getline(lst, line)) {
+    while (!line.empty() && (line.back() == '\r' || line.back() == '\n' ||
+                             line.back() == ' '))
+      line.pop_back();  // CRLF-tolerant, like the Python packer's strip()
+    if (line.empty()) continue;
+    size_t t1 = line.find('\t');
+    size_t tl = line.rfind('\t');
+    if (t1 == std::string::npos || tl == t1) continue;
+    PackEntry e;
+    e.key = strtoull(line.substr(0, t1).c_str(), nullptr, 10);
+    e.label = strtof(line.substr(t1 + 1).c_str(), nullptr);
+    e.path = line.substr(tl + 1);
+    entries.push_back(std::move(e));
+  }
+  FILE* rec = fopen(rec_path, "wb");
+  if (!rec) return -1;
+  std::ofstream idx(idx_path);
+  if (!idx) {
+    fclose(rec);
+    return -1;
+  }
+
+  const size_t n = entries.size();
+  std::vector<std::vector<uint8_t>> payloads(n);
+  std::vector<int> state(n, 0);  // 0 pending, 1 ok, 2 skip
+  std::mutex mu;
+  std::condition_variable cv_done, cv_window;
+  size_t write_pos = 0;
+  const size_t window = std::max<size_t>(64, 4 * (size_t)nthreads);
+  std::atomic<size_t> next_task{0};
+  std::string rootdir = root && root[0] ? std::string(root) + "/" : "";
+
+  auto work = [&]() {
+    for (;;) {
+      size_t i = next_task.fetch_add(1);
+      if (i >= n) return;
+      {
+        // bound the reorder buffer: don't run more than `window` ahead
+        // of the writer
+        std::unique_lock<std::mutex> lk(mu);
+        cv_window.wait(lk, [&] { return i < write_pos + window; });
+      }
+      std::vector<uint8_t> bytes;
+      std::ifstream f(rootdir + entries[i].path, std::ios::binary);
+      int ok = 0;
+      if (f) {
+        bytes.assign(std::istreambuf_iterator<char>(f),
+                     std::istreambuf_iterator<char>());
+        ok = !bytes.empty();
+      }
+      if (!ok) {
+        fprintf(stderr, "mxio_im2rec: skip unreadable %s\n",
+                entries[i].path.c_str());
+      }
+      if (ok && resize > 0) {
+        bool is_jpeg =
+            bytes.size() > 2 && bytes[0] == 0xFF && bytes[1] == 0xD8;
+        std::vector<uint8_t> rgb;
+        int w = 0, h = 0;
+        if (is_jpeg && DecodeJpeg(bytes.data(), bytes.size(), &rgb, &w,
+                                  &h)) {
+          int shorter = w < h ? w : h;
+          if (shorter != resize) {
+            double s = (double)resize / shorter;
+            int dw = (int)(w * s + 0.5), dh = (int)(h * s + 0.5);
+            std::vector<uint8_t> small;
+            Resize(rgb, w, h, &small, dw, dh);
+            rgb.swap(small);
+            w = dw;
+            h = dh;
+          }
+          std::vector<uint8_t> enc;
+          if (EncodeJpeg(rgb.data(), w, h, quality, &enc)) bytes.swap(enc);
+        } else {
+          // no libpng here: storing a non-JPEG verbatim would silently
+          // violate the resize contract AND feed the jpeg-only native
+          // loader undecodable records — skip loudly instead
+          fprintf(stderr,
+                  "mxio_im2rec: skip non-JPEG/corrupt %s (--resize "
+                  "re-encodes and requires JPEG input)\n",
+                  entries[i].path.c_str());
+          ok = 0;
+        }
+      }
+      std::vector<uint8_t> payload;
+      if (ok) {
+        IRHeaderWire hd{0, entries[i].label, entries[i].key, 0};
+        payload.resize(sizeof(hd) + bytes.size());
+        memcpy(payload.data(), &hd, sizeof(hd));
+        memcpy(payload.data() + sizeof(hd), bytes.data(), bytes.size());
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        payloads[i] = std::move(payload);
+        state[i] = ok ? 1 : 2;
+      }
+      cv_done.notify_one();
+    }
+  };
+
+  std::vector<std::thread> pool;
+  int nt = nthreads > 0 ? nthreads : 1;
+  for (int t = 0; t < nt; ++t) pool.emplace_back(work);
+
+  Writer writer;
+  writer.fp = rec;
+  int64_t written = 0;
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    for (size_t i = 0; i < n; ++i) {
+      cv_done.wait(lk, [&] { return state[i] != 0; });
+      if (state[i] == 1) {
+        long off = ftell(rec);
+        lk.unlock();
+        writer.Write(payloads[i].data(), payloads[i].size());
+        lk.lock();
+        idx << entries[i].key << "\t" << off << "\n";
+        ++written;
+      }
+      payloads[i].clear();
+      payloads[i].shrink_to_fit();
+      write_pos = i + 1;
+      cv_window.notify_all();
+    }
+  }
+  for (auto& t : pool) t.join();
+  fclose(rec);
+  idx.close();
+  return written;
+}
+
 }  // namespace
 
 extern "C" {
@@ -641,6 +840,14 @@ void mxio_aug_rotate(const uint8_t* src, int w, int h, float angle, int fill,
 
 void mxio_aug_hsl(uint8_t* img, int w, int h, int dh, int ds, int dl) {
   HslShiftU8(img, w, h, dh, ds, dl);
+}
+
+// multithreaded .lst -> .rec/.idx packer; returns records written or -1
+int64_t mxio_im2rec(const char* lst_path, const char* root,
+                    const char* rec_path, const char* idx_path, int resize,
+                    int quality, int nthreads) {
+  return Im2Rec(lst_path, root, rec_path, idx_path, resize, quality,
+                nthreads);
 }
 
 }  // extern "C"

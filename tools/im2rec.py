@@ -9,6 +9,8 @@ and mxnet_tpu.recordio.
 Usage:
   python tools/im2rec.py prefix image_root --list       # make prefix.lst
   python tools/im2rec.py prefix image_root              # pack prefix.rec
+  python tools/im2rec.py prefix image_root --native --threads 8
+                                  # multithreaded C++ packer (im2rec.cc)
 """
 import argparse
 import os
@@ -68,6 +70,22 @@ def pack(prefix, root, quality=95, resize=0):
     print("wrote %s.rec: %d records" % (prefix, n))
 
 
+def pack_native(prefix, root, quality=95, resize=0, threads=4):
+    """Delegate to the native multithreaded packer (native/recordio.cc
+    mxio_im2rec — the reference's tools/im2rec.cc)."""
+    from mxnet_tpu import native
+
+    n = native.im2rec_pack(prefix + ".lst", root, prefix + ".rec",
+                           prefix + ".idx", resize=resize, quality=quality,
+                           nthreads=threads)
+    with open(prefix + ".lst") as f:
+        listed = sum(1 for line in f if line.strip())
+    skipped = "" if n == listed else "  (%d of %d skipped — see stderr)" % (
+        listed - n, listed)
+    print("wrote %s.rec: %d records (native, %d threads)%s"
+          % (prefix, n, threads, skipped))
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("prefix")
@@ -75,11 +93,18 @@ def main():
     ap.add_argument("--list", action="store_true", help="only generate .lst")
     ap.add_argument("--quality", type=int, default=95)
     ap.add_argument("--resize", type=int, default=0)
+    ap.add_argument("--native", action="store_true",
+                    help="use the multithreaded C++ packer")
+    ap.add_argument("--threads", type=int, default=4)
     args = ap.parse_args()
     if args.list or not os.path.exists(args.prefix + ".lst"):
         make_list(args.prefix, args.root)
     if not args.list:
-        pack(args.prefix, args.root, args.quality, args.resize)
+        if args.native:
+            pack_native(args.prefix, args.root, args.quality, args.resize,
+                        args.threads)
+        else:
+            pack(args.prefix, args.root, args.quality, args.resize)
 
 
 if __name__ == "__main__":
