@@ -1393,3 +1393,190 @@ def test_flops_multi_head_attention_counting():
                                  v=(N, T, dm))["MultiHeadAttention"]
         want = 4.0 * N * T * T * dm * factor
         assert got == want, (causal, got, want)
+
+
+# --- tranche 4: reference long-tail cases ----------------------------------
+
+def test_slice_channel_squeeze_axis():
+    """reference test_operator.py test_slice_channel: num_outputs
+    splitting with and without squeeze_axis, forward and gradient
+    routing back to the right slice."""
+    x = np.random.RandomState(0).randn(2, 6, 3).astype(np.float32)
+    s = sym.SliceChannel(sym.Variable("data"), num_outputs=3, axis=1,
+                         squeeze_axis=False)
+    exe = s.simple_bind(mx.cpu(), data=x.shape, grad_req="write")
+    exe.arg_dict["data"][:] = x
+    outs = [o.asnumpy() for o in exe.forward(is_train=True)]
+    for i, o in enumerate(outs):
+        np.testing.assert_array_equal(o, x[:, 2 * i:2 * i + 2, :])
+    gs = [np.full((2, 2, 3), float(i + 1), np.float32) for i in range(3)]
+    exe.backward([nd.array(g) for g in gs])
+    np.testing.assert_array_equal(exe.grad_dict["data"].asnumpy(),
+                                  np.concatenate(gs, axis=1))
+    # squeeze_axis drops the now-1 dimension (requires exact division)
+    s2 = sym.SliceChannel(sym.Variable("data"), num_outputs=6, axis=1,
+                          squeeze_axis=True)
+    exe2 = s2.simple_bind(mx.cpu(), data=x.shape, grad_req="null")
+    exe2.arg_dict["data"][:] = x
+    outs2 = [o.asnumpy() for o in exe2.forward(is_train=False)]
+    assert all(o.shape == (2, 3) for o in outs2)
+    np.testing.assert_array_equal(outs2[4], x[:, 4, :])
+
+
+def test_binary_op_duplicate_input():
+    """reference test_binary_op_duplicate_input: the SAME variable on
+    both sides of a binary op must receive the SUM of both partials
+    (d(x*x)/dx = 2x, d(x+x)/dx = 2)."""
+    x = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    d = sym.Variable("data")
+    for expr, want in ((d * d, 2 * x), (d + d, np.full_like(x, 2.0))):
+        check_symbolic_backward(expr, {"data": x}, [np.ones_like(x)],
+                                {"data": want}, rtol=1e-5)
+
+
+def test_embedding_repeated_index_grad_accumulation():
+    """reference test_embedding: rows hit by SEVERAL batch positions
+    accumulate every contribution (scatter-ADD backward, not last-wins),
+    and grad_req='add' further accumulates across backward calls."""
+    vocab, dim = 5, 3
+    idx = np.array([1, 1, 1, 4, 0], np.float32)
+    w = np.random.RandomState(2).randn(vocab, dim).astype(np.float32)
+    s = sym.Embedding(sym.Variable("data"), input_dim=vocab,
+                      output_dim=dim, name="emb")
+    exe = s.simple_bind(mx.cpu(), data=idx.shape, grad_req="write")
+    exe.arg_dict["data"][:] = idx
+    exe.arg_dict["emb_weight"][:] = w
+    exe.forward(is_train=True)
+    g = np.arange(15, dtype=np.float32).reshape(5, 3)
+    exe.backward([nd.array(g)])
+    want = np.zeros_like(w)
+    for pos, row in enumerate(idx.astype(int)):
+        want[row] += g[pos]
+    np.testing.assert_allclose(exe.grad_dict["emb_weight"].asnumpy(),
+                               want, rtol=1e-6)
+    # grad_req='add': a second backward doubles the accumulated grad
+    exe_add = s.simple_bind(mx.cpu(), data=idx.shape, grad_req="add")
+    exe_add.arg_dict["data"][:] = idx
+    exe_add.arg_dict["emb_weight"][:] = w
+    for _ in range(2):
+        exe_add.forward(is_train=True)
+        exe_add.backward([nd.array(g)])
+    np.testing.assert_allclose(exe_add.grad_dict["emb_weight"].asnumpy(),
+                               2 * want, rtol=1e-6)
+
+
+def test_take_clip_wrap_modes():
+    """take mode='clip' clamps out-of-range indices to the edges,
+    mode='wrap' takes them modulo the axis length (reference test_take
+    mode coverage)."""
+    w = np.arange(12, dtype=np.float32).reshape(4, 3)
+    idx = np.array([-2, 0, 3, 5], np.float32)
+    got_clip = nd.take(nd.array(w), nd.array(idx), mode="clip").asnumpy()
+    np.testing.assert_array_equal(got_clip,
+                                  w[np.clip(idx.astype(int), 0, 3)])
+    got_wrap = nd.take(nd.array(w), nd.array(idx), mode="wrap").asnumpy()
+    np.testing.assert_array_equal(got_wrap, w[idx.astype(int) % 4])
+
+
+def test_convolution_grouping():
+    """reference test_convolution_grouping: num_group=G conv equals G
+    independent convs over channel slices concatenated — forward AND all
+    gradients."""
+    rng = np.random.RandomState(3)
+    N, C, H, W, F, G = 2, 4, 7, 7, 6, 2
+    x = rng.randn(N, C, H, W).astype(np.float32)
+    wt = rng.randn(F, C // G, 3, 3).astype(np.float32)
+    b = rng.randn(F).astype(np.float32)
+    s = sym.Convolution(sym.Variable("data"), kernel=(3, 3), num_filter=F,
+                        num_group=G, name="conv")
+    exe = s.simple_bind(mx.cpu(), data=x.shape, grad_req="write")
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["conv_weight"][:] = wt
+    exe.arg_dict["conv_bias"][:] = b
+    out = exe.forward(is_train=True)[0].asnumpy()
+
+    # reference graph: slice channels, conv each half, concat
+    parts = []
+    for gi in range(G):
+        ps = sym.Convolution(sym.Variable("d%d" % gi), kernel=(3, 3),
+                             num_filter=F // G, name="c%d" % gi)
+        parts.append(ps)
+    ref = sym.Concat(*parts, dim=1)
+    rexe = ref.simple_bind(mx.cpu(), grad_req="write",
+                           **{"d%d" % gi: (N, C // G, H, W)
+                              for gi in range(G)})
+    for gi in range(G):
+        rexe.arg_dict["d%d" % gi][:] = x[:, gi * (C // G):(gi + 1) * (C // G)]
+        rexe.arg_dict["c%d_weight" % gi][:] = \
+            wt[gi * (F // G):(gi + 1) * (F // G)]
+        rexe.arg_dict["c%d_bias" % gi][:] = b[gi * (F // G):(gi + 1) * (F // G)]
+    rout = rexe.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out, rout, rtol=1e-4, atol=1e-5)
+
+    g = rng.randn(*out.shape).astype(np.float32)
+    exe.backward([nd.array(g)])
+    rexe.backward([nd.array(g)])
+    got_dx = exe.grad_dict["data"].asnumpy()
+    want_dx = np.concatenate([rexe.grad_dict["d%d" % gi].asnumpy()
+                              for gi in range(G)], axis=1)
+    np.testing.assert_allclose(got_dx, want_dx, rtol=1e-4, atol=1e-5)
+    got_dw = exe.grad_dict["conv_weight"].asnumpy()
+    want_dw = np.concatenate([rexe.grad_dict["c%d_weight" % gi].asnumpy()
+                              for gi in range(G)], axis=0)
+    np.testing.assert_allclose(got_dw, want_dw, rtol=1e-4, atol=1e-5)
+
+
+def test_convolution_dilated_impulse_response():
+    """reference test_convolution_dilated_impulse_response: a unit
+    impulse through a dilated all-ones kernel lights up exactly the
+    dilated tap grid."""
+    for dil in ((1, 1), (2, 2), (3, 3)):
+        x = np.zeros((1, 1, 15, 15), np.float32)
+        x[0, 0, 7, 7] = 1.0
+        s = sym.Convolution(sym.Variable("data"), kernel=(3, 3),
+                            dilate=dil, num_filter=1, no_bias=True,
+                            pad=(dil[0], dil[1]), name="conv")
+        exe = s.simple_bind(mx.cpu(), data=x.shape, grad_req="null")
+        exe.arg_dict["data"][:] = x
+        exe.arg_dict["conv_weight"][:] = np.ones((1, 1, 3, 3), np.float32)
+        out = exe.forward(is_train=False)[0].asnumpy()[0, 0]
+        want = np.zeros((15, 15), np.float32)
+        for dy in (-dil[0], 0, dil[0]):
+            for dx in (-dil[1], 0, dil[1]):
+                want[7 + dy, 7 + dx] = 1.0
+        np.testing.assert_array_equal(out, want, err_msg="dilate=%s" % (dil,))
+
+
+def test_special_functions_vs_scipy():
+    """reference test_special_functions_using_scipy: gamma/gammaln
+    forward against scipy, gradients against the digamma identity."""
+    sp = pytest.importorskip("scipy.special")
+
+    x = np.array([0.3, 1.0, 2.5, 4.2], np.float32)
+    np.testing.assert_allclose(nd.gamma(nd.array(x)).asnumpy(),
+                               sp.gamma(x), rtol=1e-4)
+    np.testing.assert_allclose(nd.gammaln(nd.array(x)).asnumpy(),
+                               sp.gammaln(x), rtol=1e-4, atol=1e-5)
+    # d/dx gamma(x) = gamma(x) * digamma(x); d/dx gammaln(x) = digamma(x)
+    for fn, want in (("gamma", sp.gamma(x) * sp.digamma(x)),
+                     ("gammaln", sp.digamma(x))):
+        s = getattr(sym, fn)(sym.Variable("data"))
+        exe = s.simple_bind(mx.cpu(), data=x.shape, grad_req="write")
+        exe.arg_dict["data"][:] = x
+        exe.forward(is_train=True)
+        exe.backward([nd.array(np.ones_like(x))])
+        np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(), want,
+                                   rtol=1e-3, err_msg=fn)
+
+
+def test_log_softmax_matches_log_of_softmax():
+    """reference test_log_softmax (+ the new_softmax axis semantics):
+    log_softmax == log(softmax) computed stably, with matching grads."""
+    rng = np.random.RandomState(5)
+    x = (rng.randn(3, 7) * 10).astype(np.float32)  # big logits: stability
+    got = nd.log_softmax(nd.array(x)).asnumpy()
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    want = np.log(e / e.sum(axis=-1, keepdims=True))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    s = sym.log_softmax(sym.Variable("data"))
+    check_numeric_gradient(s, {"data": x / 10}, rtol=1e-2, atol=1e-3)
