@@ -108,6 +108,45 @@ class Symbol:
                     entries.append((node, i))
         return Symbol(entries)
 
+    def get_children(self) -> Optional["Symbol"]:
+        """Grouped symbol of the output nodes' immediate inputs, in
+        order; None for a pure-variable symbol (reference
+        python/mxnet/symbol.py get_children / test_symbol.py
+        test_symbol_children semantics). A multi-output node contributes
+        its inputs ONCE, not per selected output."""
+        entries = []
+        seen = set()
+        for node, _ in self._entries:
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            entries.extend(node.inputs)
+        if not entries:
+            return None
+        return Symbol(entries)
+
+    def __reduce__(self):
+        # op impls are closures (unpicklable); the versioned JSON schema
+        # is the durable form, so pickle round-trips THROUGH it
+        # (reference test_symbol.py test_symbol_pickle capability).
+        # Ephemeral ops (grad()'s synthesized backward nodes) are not in
+        # the registry, so their JSON could never load back — fail at
+        # DUMP time, not in some later process with a corrupt blob.
+        from .ops.registry import OP_REGISTRY
+
+        for n in self._nodes():
+            if not n.is_var and n.op.name not in OP_REGISTRY:
+                raise MXNetError(
+                    "cannot pickle symbol: op %r is not in the registry "
+                    "(ephemeral gradient/internal node)" % n.op.name)
+        return (load_json, (self.tojson(),))
+
+    def __deepcopy__(self, memo):
+        # without this, copy.deepcopy would fall back to __reduce_ex__
+        # and route through the JSON schema (breaking ephemeral-op
+        # symbols that the structural __copy__ handles fine)
+        return self.__copy__()
+
     def __getitem__(self, index):
         if isinstance(index, str):
             outs = self.list_outputs()
@@ -631,7 +670,13 @@ class Symbol:
                 {
                     "op": "null" if n.is_var else n.op.name,
                     "name": n.name,
-                    "attrs": {k: repr(v) if not isinstance(v, str) else v for k, v in n.attrs.items()},
+                    # None serializes as "null" (the enum spelling the
+                    # loader's coerce_attr maps back to None), so
+                    # save->load->save is byte-stable
+                    "attrs": {k: ("null" if v is None
+                                  else repr(v) if not isinstance(v, str)
+                                  else v)
+                              for k, v in n.attrs.items()},
                     "inputs": [[idx[id(c)], i, 0] for c, i in n.inputs],
                     "is_aux": bool(n.is_aux),
                     "misc_attrs": n.misc_attrs,

@@ -130,3 +130,46 @@ def test_symbol_grad():
     net = sym.sum(sym.BatchNorm(sym.Variable("data"), name="bn"))
     g2 = net.grad(["data"])
     assert g2.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+
+
+def test_symbol_children():
+    """reference test_symbol.py test_symbol_children: get_children walks
+    one level of inputs in order; a variable's children are None."""
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=10, name="fc1")
+    net1 = mx.sym.FullyConnected(fc1, num_hidden=100, name="fc2")
+    assert net1.get_children().list_outputs() == \
+        ["fc1_output", "fc2_weight", "fc2_bias"]
+    assert net1.get_children().get_children().list_outputs() == \
+        ["data", "fc1_weight", "fc1_bias"]
+    assert net1.get_children()["fc2_weight"].list_arguments() == \
+        ["fc2_weight"]
+    assert net1.get_children()["fc2_weight"].get_children() is None
+
+    sliced = mx.sym.SliceChannel(mx.sym.Variable("data"), num_outputs=3,
+                                 name="slice")
+    concat = mx.sym.Concat(*list(sliced))
+    assert concat.get_children().list_outputs() == \
+        ["slice_output0", "slice_output1", "slice_output2"]
+    assert sliced.get_children().list_outputs() == ["data"]
+
+
+def test_symbol_pickle():
+    """reference test_symbol_pickle: symbols pickle (through the JSON
+    schema — op impls are closures) and stay bindable."""
+    import pickle
+
+    import numpy as np
+
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                              name="fc"),
+        mx.sym.Variable("softmax_label"), name="softmax")
+    back = pickle.loads(pickle.dumps(net, protocol=2))
+    assert back.list_arguments() == net.list_arguments()
+    assert back.tojson() == net.tojson()
+    exe = back.simple_bind(mx.cpu(), data=(3, 5), softmax_label=(3,),
+                           grad_req="write")
+    exe.arg_dict["data"][:] = np.ones((3, 5), np.float32)
+    out = exe.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(3), rtol=1e-5)
