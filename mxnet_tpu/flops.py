@@ -151,7 +151,20 @@ def _node_flops(opname, attrs, in_shapes, out_shape) -> float:
         tk = int(k[1])
         causal = str(attrs.get("causal", False)) in ("True", "true", "1")
         f = 4.0 * n * tq * tk * dmq  # H·Dh == dmq (query width)
-        return f / 2.0 if causal else f
+        if causal:
+            # useful (unmasked) count: query row i sees keys
+            # [0, i + tk - tq], i.e. max(0, tk - tq + 1 + i) of them.
+            # tq <= tk: every row sees >= 1 key, closed form
+            # tq*(tk - (tq-1)/2); tq > tk: the first tq-tk rows see
+            # nothing and the rest see 1..tk (clamping matters — the
+            # unclamped form goes NEGATIVE). ~f/2 at tq == tk; > f/2
+            # for cross-length causal (tq < tk with key offset).
+            if tq <= tk:
+                rows = tq * (tk - (tq - 1) / 2.0)
+            else:
+                rows = tk * (tk + 1) / 2.0
+            return f * rows / (tq * tk)
+        return f
     if opname == "RNN":
         # fused multi-layer RNN: dominated by 8 gate matmuls per LSTM step
         # (4 gates x {input, hidden}). Use weight blob size as MAC count

@@ -39,8 +39,14 @@ class ImageRecordIter(DataIter):
         self.data_shape = tuple(int(x) for x in data_shape)
         self._path = path_imgrec
         self._round_batch = round_batch
+        self.label_width = int(label_width)
         self._provide_data = [DataDesc("data", (batch_size,) + self.data_shape)]
-        self._provide_label = [DataDesc("softmax_label", (batch_size,))]
+        # label_width > 1: records pack k float32 labels (flag=k) and the
+        # batch labels come out (N, k) — the reference's multi-label mode
+        self._provide_label = [DataDesc(
+            "softmax_label",
+            (batch_size, self.label_width) if self.label_width > 1
+            else (batch_size,))]
         self._native = None
         self._py_fallback = None
         aug_kwargs = dict(max_rotate_angle=max_rotate_angle, rotate=rotate,
@@ -58,14 +64,14 @@ class ImageRecordIter(DataIter):
                 part_index=part_index, num_parts=num_parts, seed=seed,
                 resize_shorter=resize, queue_depth=prefetch_buffer,
                 shuffle_buffer=(max(4 * batch_size, 2048) if shuffle else 0),
-                **aug_kwargs)
+                label_width=self.label_width, **aug_kwargs)
         except Exception:
             self._py_fallback = _PyImageRecordReader(
                 path_imgrec, self.data_shape, rand_crop, rand_mirror,
                 (mean_r, mean_g, mean_b), (std_r, std_g, std_b), resize,
                 part_index, num_parts, seed,
                 shuffle_buffer=(max(4 * batch_size, 2048) if shuffle else 0),
-                **aug_kwargs)
+                label_width=self.label_width, **aug_kwargs)
 
     @property
     def provide_data(self):
@@ -110,7 +116,7 @@ class _PyImageRecordReader:
     def __init__(self, path, data_shape, rand_crop, rand_mirror, mean, std,
                  resize, part_index, num_parts, seed, shuffle_buffer=0,
                  max_rotate_angle=0, rotate=-1, fill_value=255,
-                 random_h=0, random_s=0, random_l=0):
+                 random_h=0, random_s=0, random_l=0, label_width=1):
         self._stream = _ShardedRecordStream(path, part_index, num_parts,
                                             seed, shuffle_buffer)
         self.data_shape = data_shape
@@ -124,6 +130,7 @@ class _PyImageRecordReader:
         self.fill_value = fill_value
         self.random_h, self.random_s, self.random_l = \
             int(random_h), int(random_s), int(random_l)
+        self.label_width = int(label_width)
         self._rng = np.random.RandomState(seed)
 
     def reset(self):
@@ -139,7 +146,9 @@ class _PyImageRecordReader:
 
         c, h, w = self.data_shape
         data = np.zeros((batch_size, c, h, w), np.float32)
-        labels = np.zeros((batch_size,), np.float32)
+        lw = self.label_width
+        labels = np.zeros((batch_size, lw) if lw > 1 else (batch_size,),
+                          np.float32)
         n = 0
         while n < batch_size:
             buf = self._next_my_record()
@@ -192,8 +201,12 @@ class _PyImageRecordReader:
                 img = img[:, ::-1]
             chw = img.transpose(2, 0, 1).astype(np.float32)
             data[n] = (chw - self.mean) / self.std
-            lab = header.label
-            labels[n] = float(lab if np.isscalar(lab) else np.asarray(lab).flat[0])
+            lab = np.atleast_1d(np.asarray(header.label, np.float32))
+            if lw > 1:
+                k = min(lw, lab.size)
+                labels[n, :k] = lab[:k]
+            else:
+                labels[n] = lab.flat[0]
             n += 1
         if n == 0:
             return None

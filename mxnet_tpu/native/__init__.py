@@ -85,6 +85,13 @@ def _bind(lib: ctypes.CDLL) -> None:
     except AttributeError:
         lib._mxtpu_has_aug = False
     try:
+        lib.mxio_imgloader_create2.restype = ctypes.c_void_p
+        lib.mxio_imgloader_create2.argtypes = \
+            list(lib.mxio_imgloader_create.argtypes) + [ctypes.c_int]
+        lib._mxtpu_has_label_width = True
+    except AttributeError:
+        lib._mxtpu_has_label_width = False
+    try:
         lib.mxio_im2rec.restype = ctypes.c_int64
         lib.mxio_im2rec.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
@@ -197,7 +204,7 @@ class NativeImageLoader:
                  std_rgb=None, part_index=0, num_parts=1, seed=0,
                  resize_shorter=0, queue_depth=2, shuffle_buffer=0,
                  max_rotate_angle=0, rotate=-1, fill_value=255,
-                 random_h=0, random_s=0, random_l=0):
+                 random_h=0, random_s=0, random_l=0, label_width=1):
         lib = load()
         if lib is None:
             raise RuntimeError("native io library unavailable")
@@ -216,13 +223,28 @@ class NativeImageLoader:
                                  int(random_s), int(random_l))
         self.batch_size = batch_size
         self.data_shape = data_shape
+        self.label_width = int(label_width)
         self._data = np.empty((batch_size, c, h, w), np.float32)
-        self._labels = np.empty((batch_size,), np.float32)
-        self._h = lib.mxio_imgloader_create(
-            path.encode(), batch_size, h, w, c, nthreads,
-            int(rand_crop), int(rand_mirror), mean, std,
-            part_index, num_parts, seed, resize_shorter, queue_depth,
-            shuffle_buffer, aug)
+        if self.label_width > 1:
+            if not getattr(lib, "_mxtpu_has_label_width", False):
+                # old prebuilt .so would silently read zeros for packed
+                # labels — fall back to the Python reader, which honors it
+                raise RuntimeError("native io library too old for "
+                                   "label_width")
+            self._labels = np.empty((batch_size, self.label_width),
+                                    np.float32)
+            self._h = lib.mxio_imgloader_create2(
+                path.encode(), batch_size, h, w, c, nthreads,
+                int(rand_crop), int(rand_mirror), mean, std,
+                part_index, num_parts, seed, resize_shorter, queue_depth,
+                shuffle_buffer, aug, self.label_width)
+        else:
+            self._labels = np.empty((batch_size,), np.float32)
+            self._h = lib.mxio_imgloader_create(
+                path.encode(), batch_size, h, w, c, nthreads,
+                int(rand_crop), int(rand_mirror), mean, std,
+                part_index, num_parts, seed, resize_shorter, queue_depth,
+                shuffle_buffer, aug)
         if not self._h:
             raise IOError("cannot open %s" % path)
 

@@ -14,7 +14,7 @@
 // Record framing matches mxnet_tpu/recordio.py (and the reference
 // dmlc recordio): [kMagic u32][cflag<<29|len u32][payload][pad4].
 // Image payload: IRHeader{u32 flag; f32 label; u64 id,id2}
-//                [flag>1 ? flag*f32 labels] [jpeg bytes].
+//                [flag>0 ? flag*f32 labels] [jpeg bytes].
 
 #include <cstdint>
 #include <cstdio>
@@ -133,15 +133,19 @@ bool EncodeJpeg(const uint8_t* rgb, int w, int h, int quality,
   JpegErr jerr;
   cinfo.err = jpeg_std_error(&jerr.mgr);
   jerr.mgr.error_exit = jpeg_err_exit;
-  unsigned char* mem = nullptr;
-  unsigned long mem_size = 0;
+  // volatile: modified between setjmp and a potential longjmp
+  // (jpeg_mem_dest/jpeg_finish_compress reassign it); a non-volatile
+  // local would be indeterminate in the error path's free(mem)
+  unsigned char* volatile mem = nullptr;
+  unsigned long volatile mem_size = 0;
   if (setjmp(jerr.jb)) {
     jpeg_destroy_compress(&cinfo);
     free(mem);
     return false;
   }
   jpeg_create_compress(&cinfo);
-  jpeg_mem_dest(&cinfo, &mem, &mem_size);
+  jpeg_mem_dest(&cinfo, const_cast<unsigned char**>(&mem),
+                const_cast<unsigned long*>(&mem_size));
   cinfo.image_width = w;
   cinfo.image_height = h;
   cinfo.input_components = 3;
@@ -291,11 +295,14 @@ struct LoaderCfg {
   int rotate = -1;           // fixed angle; overrides max_rotate_angle
   int fill_value = 255;      // border fill for rotation
   int random_h = 0, random_s = 0, random_l = 0;  // HLS jitter extents
+  // labels per record (reference label_width): rows of k float32s read
+  // from flag>0 records' packed labels; flag==0 records fill row[0]
+  int label_width = 1;
 };
 
 struct Batch {
   std::vector<float> data;    // batch*C*H*W
-  std::vector<float> labels;  // batch
+  std::vector<float> labels;  // batch*label_width
   int n = 0;
 };
 
@@ -334,7 +341,7 @@ struct ImgLoader {
     float label;
     memcpy(&flag, p, 4);
     memcpy(&label, p + 4, 4);
-    size_t off = 24 + (flag > 1 ? (size_t)flag * 4 : 0);
+    size_t off = 24 + (flag > 0 ? (size_t)flag * 4 : 0);
     if (off >= len) return false;
     int w0, h0;
     std::vector<uint8_t> rgb, resized;
@@ -416,7 +423,18 @@ struct ImgLoader {
         }
       }
     }
-    b->labels[w.slot] = label;
+    int lw = c.label_width;
+    float* lrow = b->labels.data() + (size_t)w.slot * lw;
+    for (int j = 0; j < lw; ++j) lrow[j] = 0.0f;
+    if (flag > 0 && len >= 24 + (size_t)flag * 4) {
+      // packed multi-label record: the inline label is 0 by convention,
+      // the real labels sit after the header — even label_width==1
+      // readers want labels[0], not the zero placeholder
+      size_t have = flag < (uint32_t)lw ? flag : (uint32_t)lw;
+      memcpy(lrow, p + 24, have * 4);
+    } else {
+      lrow[0] = label;
+    }
     return true;
   }
 
@@ -485,13 +503,15 @@ struct ImgLoader {
       }
       // compact failed slots out of the batch
       size_t img = (size_t)cfg.C * cfg.H * cfg.W;
+      size_t lw = (size_t)cfg.label_width;
       int m = 0;
       for (int i = 0; i < n; ++i) {
         if (!ok[i]) continue;
         if (m != i) {
           memcpy(b->data.data() + (size_t)m * img,
                  b->data.data() + (size_t)i * img, img * sizeof(float));
-          b->labels[m] = b->labels[i];
+          memcpy(b->labels.data() + (size_t)m * lw,
+                 b->labels.data() + (size_t)i * lw, lw * sizeof(float));
         }
         ++m;
       }
@@ -544,7 +564,7 @@ static_assert(sizeof(IRHeaderWire) == 24, "IRHeader wire layout");
 
 struct PackEntry {
   uint64_t key;
-  float label;
+  std::vector<float> labels;  // 1 = inline (flag 0); k>1 = flag=k + floats
   std::string path;
 };
 
@@ -564,7 +584,16 @@ int64_t Im2Rec(const char* lst_path, const char* root, const char* rec_path,
     if (t1 == std::string::npos || tl == t1) continue;
     PackEntry e;
     e.key = strtoull(line.substr(0, t1).c_str(), nullptr, 10);
-    e.label = strtof(line.substr(t1 + 1).c_str(), nullptr);
+    // every tab-separated field between key and path is a label float —
+    // multi-label .lst lines (label_width > 1) pack flag=k + k floats,
+    // matching recordio.py's pack() convention; parsing only the first
+    // would silently drop labels 2..k
+    for (size_t p = t1 + 1; p < tl + 1;) {
+      size_t q = line.find('\t', p);
+      if (q == std::string::npos || q > tl) q = tl;
+      e.labels.push_back(strtof(line.substr(p, q - p).c_str(), nullptr));
+      p = q + 1;
+    }
     e.path = line.substr(tl + 1);
     entries.push_back(std::move(e));
   }
@@ -640,10 +669,23 @@ int64_t Im2Rec(const char* lst_path, const char* root, const char* rec_path,
       }
       std::vector<uint8_t> payload;
       if (ok) {
-        IRHeaderWire hd{0, entries[i].label, entries[i].key, 0};
-        payload.resize(sizeof(hd) + bytes.size());
-        memcpy(payload.data(), &hd, sizeof(hd));
-        memcpy(payload.data() + sizeof(hd), bytes.data(), bytes.size());
+        const std::vector<float>& lab = entries[i].labels;
+        size_t k = lab.size();
+        if (k <= 1) {
+          IRHeaderWire hd{0, k ? lab[0] : 0.0f, entries[i].key, 0};
+          payload.resize(sizeof(hd) + bytes.size());
+          memcpy(payload.data(), &hd, sizeof(hd));
+          memcpy(payload.data() + sizeof(hd), bytes.data(), bytes.size());
+        } else {
+          // recordio.py pack(): flag = label count, inline label = 0,
+          // k float32 labels between the header and the image bytes
+          IRHeaderWire hd{(uint32_t)k, 0.0f, entries[i].key, 0};
+          payload.resize(sizeof(hd) + k * 4 + bytes.size());
+          memcpy(payload.data(), &hd, sizeof(hd));
+          memcpy(payload.data() + sizeof(hd), lab.data(), k * 4);
+          memcpy(payload.data() + sizeof(hd) + k * 4, bytes.data(),
+                 bytes.size());
+        }
       }
       {
         std::lock_guard<std::mutex> lk(mu);
@@ -739,12 +781,13 @@ void mxio_writer_close(void* h) {
 // aug_params: optional int[6] {max_rotate_angle, rotate, fill_value,
 // random_h, random_s, random_l} (reference DefaultImageAugmentParam);
 // nullptr keeps the defaults (no rotation, no color jitter).
-void* mxio_imgloader_create(const char* path, int batch, int H, int W, int C,
-                            int nthreads, int rand_crop, int rand_mirror,
-                            const float* mean_rgb, const float* std_rgb,
-                            int part, int nparts, uint64_t seed,
-                            int resize_shorter, int queue_depth,
-                            int shuffle_buffer, const int* aug_params) {
+void* mxio_imgloader_create2(const char* path, int batch, int H, int W,
+                             int C, int nthreads, int rand_crop,
+                             int rand_mirror, const float* mean_rgb,
+                             const float* std_rgb, int part, int nparts,
+                             uint64_t seed, int resize_shorter,
+                             int queue_depth, int shuffle_buffer,
+                             const int* aug_params, int label_width) {
   FILE* fp = fopen(path, "rb");
   if (!fp) return nullptr;
   ImgLoader* L = new ImgLoader();
@@ -765,6 +808,7 @@ void* mxio_imgloader_create(const char* path, int batch, int H, int W, int C,
     L->cfg.random_s = aug_params[4];
     L->cfg.random_l = aug_params[5];
   }
+  L->cfg.label_width = label_width > 1 ? label_width : 1;
   L->nthreads = nthreads;
   L->seed = seed;
   L->shuffle_buffer = shuffle_buffer;
@@ -773,11 +817,23 @@ void* mxio_imgloader_create(const char* path, int batch, int H, int W, int C,
   L->storage.resize(queue_depth);
   for (auto& b : L->storage) {
     b.data.resize((size_t)batch * C * H * W);
-    b.labels.resize(batch);
+    b.labels.resize((size_t)batch * L->cfg.label_width);
     L->free_pool.push(&b);
   }
   L->Start();
   return L;
+}
+
+void* mxio_imgloader_create(const char* path, int batch, int H, int W, int C,
+                            int nthreads, int rand_crop, int rand_mirror,
+                            const float* mean_rgb, const float* std_rgb,
+                            int part, int nparts, uint64_t seed,
+                            int resize_shorter, int queue_depth,
+                            int shuffle_buffer, const int* aug_params) {
+  return mxio_imgloader_create2(path, batch, H, W, C, nthreads, rand_crop,
+                                rand_mirror, mean_rgb, std_rgb, part, nparts,
+                                seed, resize_shorter, queue_depth,
+                                shuffle_buffer, aug_params, 1);
 }
 
 int mxio_imgloader_next(void* h, float* data, float* labels) {

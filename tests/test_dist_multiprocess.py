@@ -222,6 +222,27 @@ def test_launcher_mpi_sge_yarn_wiring():
               "python", "train.py")
     assert "#$ -t 1-3" in out
     assert "export MXNET_TPU_COORDINATOR=" in out and "train.py" in out
+    # default mode: task 1 (rank 0 — where jax.distributed hosts the
+    # coordinator) publishes its hostname through a shared-FS rendezvous
+    # file; other tasks poll it. Pinning the submit host would dial a
+    # node the scheduler likely did not place rank 0 on.
+    assert "hostname -f" in out and "$RDV" in out
+    assert '"$SGE_TASK_ID" = "1"' in out
+
+    # MXNET_TPU_COORD_HOST pins the coordinator verbatim (sge AND mpi)
+    env = dict(os.environ, MXNET_TPU_COORD_HOST="sgehost.example")
+    p = subprocess.run([_sys.executable, launch, "-n", "3", "--launcher",
+                        "sge", "--dry-run", "python", "train.py"],
+                       capture_output=True, text=True, timeout=60, env=env)
+    assert p.returncode == 0, p.stderr
+    assert "export MXNET_TPU_COORDINATOR=sgehost.example:" in p.stdout
+    assert "$RDV" not in p.stdout
+    p = subprocess.run([_sys.executable, launch, "-n", "2", "--launcher",
+                        "mpi", "--dry-run", "python", "train.py"],
+                       capture_output=True, text=True, timeout=60,
+                       env=dict(env, MXNET_TPU_COORD_HOST="rank0.example"))
+    assert p.returncode == 0, p.stderr
+    assert "MXNET_TPU_COORDINATOR=rank0.example:" in p.stdout
 
     out = run("-n", "2", "--launcher", "yarn", "python", "train.py")
     assert "-num_containers 2" in out

@@ -525,6 +525,77 @@ def test_native_im2rec_roundtrip(tmp_path):
     loader.close()
 
 
+def test_native_im2rec_multilabel(tmp_path):
+    """A label_width>1 .lst line packs flag=k + k float32 labels
+    (recordio.py pack() convention) — NOT just the first label with the
+    rest silently dropped (the reference's im2rec.cc packs label_width
+    extras with flag>0)."""
+    pytest.importorskip("cv2")
+    from mxnet_tpu import native
+
+    if not native.available() or not getattr(native.load(),
+                                             "_mxtpu_has_im2rec", False):
+        pytest.skip("native io library unavailable")
+    root = str(tmp_path / "imgs")
+    paths = _write_test_images(root, 3)
+    prefix = str(tmp_path / "data")
+    labels = {0: [1.0], 1: [2.0, 0.25, -3.5], 2: [4.0, 5.0]}
+    with open(prefix + ".lst", "w") as f:
+        for i, p in enumerate(paths):
+            rel = os.path.relpath(p, root)
+            f.write("%d\t%s\t%s\n" % (
+                i, "\t".join("%g" % v for v in labels[i]), rel))
+
+    n = native.im2rec_pack(prefix + ".lst", root, prefix + ".rec",
+                           prefix + ".idx", nthreads=2)
+    assert n == 3
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    for key, want in labels.items():
+        header, img = recordio.unpack_img(rec.read_idx(key))
+        assert header.id == key and img is not None
+        if len(want) == 1:
+            assert header.flag == 0 and header.label == want[0]
+        else:
+            got = np.asarray(header.label, dtype=np.float32)
+            assert got.shape == (len(want),)
+            np.testing.assert_allclose(got, np.float32(want))
+    rec.close()
+
+    # flag==1 records (recordio.pack writes flag=label.size for ANY array
+    # label, including size 1) must decode through the native loader: the
+    # image offset is 24 + flag*4 for flag > 0, per unpack()'s convention
+    # — a flag>1-only check made the loader hand label bytes to the JPEG
+    # decoder and silently drop every such record
+    import cv2 as _cv2
+    w1 = recordio.MXRecordIO(prefix + "_f1.rec", "w")
+    enc = _cv2.imencode(".jpg", (np.random.RandomState(1)
+                                 .rand(20, 20, 3) * 255).astype(np.uint8))[1]
+    w1.write(recordio.pack(recordio.IRHeader(0, np.float32([7.5]), 0, 0),
+                           enc.tobytes()))
+    w1.close()
+    from mxnet_tpu.native import NativeImageLoader
+    ld = NativeImageLoader(prefix + "_f1.rec", batch_size=1,
+                           data_shape=(3, 16, 16), nthreads=1)
+    got = ld.next_batch()
+    assert got is not None and got[2] == 1
+    assert got[1][0] == 7.5
+    ld.close()
+
+    # ImageRecordIter(label_width=k) reads the packed rows as (N, k) —
+    # the native loader fills short rows with zeros, and flag==0 records
+    # put their inline label in column 0
+    import mxnet_tpu as mx
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 16, 16), batch_size=3,
+                               label_width=3)
+    assert it.provide_label[0].shape == (3, 3)
+    lab = it.next().label[0].asnumpy()
+    rows = sorted(lab.tolist())
+    want_rows = sorted([[1.0, 0.0, 0.0], [2.0, 0.25, -3.5],
+                        [4.0, 5.0, 0.0]])
+    np.testing.assert_allclose(rows, want_rows)
+
+
 def test_native_im2rec_resize(tmp_path):
     """resize=K re-encodes with the shorter side scaled to K (aspect
     kept), decodable by the Python reader."""

@@ -1377,8 +1377,10 @@ def test_roipooling_boundaries():
 
 def test_flops_multi_head_attention_counting():
     """flops.count_flops credits MultiHeadAttention with 4*N*Tq*Tk*dmq
-    (two matmuls per head), halved for causal — the term behind the LM
-    MFU numbers in docs/perf.md."""
+    (two matmuls per head); causal counts the USEFUL (unmasked)
+    fraction exactly — (tk - (tq-1)/2)/tk, which is ~1/2 at tq==tk but
+    >1/2 for cross-length causal (tq<tk with key offset) — the term
+    behind the LM MFU numbers in docs/perf.md."""
     from mxnet_tpu import flops as _flops
 
     N, T, H, D = 2, 256, 4, 32
@@ -1386,13 +1388,36 @@ def test_flops_multi_head_attention_counting():
     q = sym.Variable("q")
     k = sym.Variable("k")
     v = sym.Variable("v")
-    for causal, factor in ((False, 1.0), (True, 0.5)):
+    for causal, factor in ((False, 1.0), (True, (T + 1) / (2.0 * T))):
         a = sym.MultiHeadAttention(query=q, key=k, value=v, num_heads=H,
                                    causal=causal)
         got = _flops.count_flops(a, q=(N, T, dm), k=(N, T, dm),
                                  v=(N, T, dm))["MultiHeadAttention"]
         want = 4.0 * N * T * T * dm * factor
         assert got == want, (causal, got, want)
+
+    # cross-length causal (decode-style: tq queries against a longer
+    # tk cache): row i sees tk - tq + 1 + i keys; the mean visible
+    # fraction is (tk - (tq-1)/2)/tk — halving would undercount
+    tq, tk = 64, 256
+    a = sym.MultiHeadAttention(query=q, key=k, value=v, num_heads=H,
+                               causal=True)
+    got = _flops.count_flops(a, q=(N, tq, dm), k=(N, tk, dm),
+                             v=(N, tk, dm))["MultiHeadAttention"]
+    want = 4.0 * N * tq * tk * dm * (tk - (tq - 1) / 2.0) / tk
+    assert got == want
+    # exact row-sum cross-check: sum_i (tk - tq + 1 + i)
+    rows = sum(tk - tq + 1 + i for i in range(tq))
+    assert abs(want - 4.0 * N * dm * rows) < 1e-6 * want
+
+    # tq > tk (more queries than keys): rows with zero visible keys
+    # clamp at 0 — the unclamped formula would go NEGATIVE
+    tq, tk = 256, 64
+    got = _flops.count_flops(a, q=(N, tq, dm), k=(N, tk, dm),
+                             v=(N, tk, dm))["MultiHeadAttention"]
+    rows = sum(max(0, tk - tq + 1 + i) for i in range(tq))
+    assert got > 0
+    assert abs(got - 4.0 * N * dm * rows) < 1e-6 * got
 
 
 # --- tranche 4: reference long-tail cases ----------------------------------

@@ -53,7 +53,13 @@ def pack(prefix, root, quality=95, resize=0):
     with open(prefix + ".lst") as f:
         for line in f:
             parts = line.strip().split("\t")
-            idx, label, rel = int(parts[0]), float(parts[1]), parts[-1]
+            idx, rel = int(parts[0]), parts[-1]
+            # all fields between key and path are labels; label_width > 1
+            # packs flag=k + k float32s (recordio.pack convention) — the
+            # native packer does the same
+            labs = [float(v) for v in parts[1:-1]]
+            label = labs[0] if len(labs) == 1 else np.asarray(labs,
+                                                             np.float32)
             img = cv2.imread(os.path.join(root, rel), cv2.IMREAD_COLOR)
             if img is None:
                 print("skip unreadable %s" % rel, file=sys.stderr)

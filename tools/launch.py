@@ -187,9 +187,15 @@ def launch_mpi(n, cmd, hostfile=None, dry_run=False):
     reference tools/launch.py:33-60). mpirun exports per-rank identity
     (OMPI_COMM_WORLD_RANK/SIZE or PMI_RANK/SIZE) which
     `mxnet_tpu.parallel.dist.init()` reads; the launcher's job is only
-    to pin the coordinator address every rank should dial."""
-    host = "127.0.0.1"
-    if hostfile:
+    to pin the coordinator address every rank should dial.
+
+    Coordinator placement ASSUMES mpirun's default by-slot mapping puts
+    rank 0 on the first hostfile entry. With custom mappings (--map-by
+    node, rankfiles, relative slot counts) rank 0 can land elsewhere —
+    set MXNET_TPU_COORD_HOST to the host that will run rank 0 and it is
+    honored verbatim."""
+    host = os.environ.get("MXNET_TPU_COORD_HOST") or "127.0.0.1"
+    if hostfile and not os.environ.get("MXNET_TPU_COORD_HOST"):
         hosts = _read_hostfile(hostfile)
         if hosts:
             host = hosts[0]
@@ -211,24 +217,58 @@ def launch_mpi(n, cmd, hostfile=None, dry_run=False):
 
 def sge_job_script(n, cmd):
     """The qsub array-job script text: N tasks, rank = SGE_TASK_ID - 1
-    (dist.init reads SGE_TASK_ID/FIRST/STEPSIZE/LAST), coordinator on
-    the submit host — resolved NOW, at generation time: a shell
-    $(hostname) would expand per-task on each execution host and every
-    rank would dial a different address."""
-    import socket
+    (dist.init reads SGE_TASK_ID/FIRST/STEPSIZE/LAST).
 
-    coord = _coord(os.environ.get("MXNET_TPU_COORD_HOST")
-                   or socket.getfqdn())
+    Coordinator placement: jax.distributed's coordinator service is
+    HOSTED BY RANK 0 — SGE task 1 — which the scheduler places on an
+    arbitrary exec host (the submit host would only be right by luck;
+    the reference's dmlc sge tracker could pin the submit host because
+    its rendezvous ran there as a separate process, which
+    jax.distributed does not do). So task 1 publishes its own hostname
+    to a shared-FS rendezvous file under -cwd (SGE jobs share the
+    submit cwd) and the other tasks poll for it before exec'ing the
+    command. MXNET_TPU_COORD_HOST overrides: set it to the exec host
+    that will run task 1 and the file dance is skipped."""
     joined = " ".join(shlex.quote(c) for c in cmd)
-    return "\n".join([
+    port = int(os.environ.get("MXNET_TPU_PORT", "12975"))
+    lines = [
         "#!/bin/bash",
         "#$ -cwd",
         "#$ -t 1-%d" % n,
         "#$ -S /bin/bash",
-        "export MXNET_TPU_COORDINATOR=%s" % coord,
-        joined,
-        "",
-    ])
+    ]
+    coord_host = os.environ.get("MXNET_TPU_COORD_HOST")
+    if coord_host:
+        # resolved NOW, at generation time: a shell $(hostname) would
+        # expand per-task on each execution host and every rank would
+        # dial a different address
+        lines.append("export MXNET_TPU_COORDINATOR=%s" % _coord(coord_host))
+    else:
+        lines += [
+            'RDV=".mxnet_tpu_coord.$JOB_ID"',
+            'if [ "$SGE_TASK_ID" = "1" ]; then',
+            # write-then-rename so pollers never read a partial file;
+            # task 1 owns the file's lifetime (trap removes it on exit —
+            # without it every job litters the shared cwd, and a
+            # qsub -r y rerun of task 1 on a NEW host could hand peers
+            # the dead previous host). The rerun case also rewrites
+            # unconditionally, so late-joining peers see the new host.
+            '  trap \'rm -f "$RDV"\' EXIT',
+            '  hostname -f > "$RDV.tmp" && mv "$RDV.tmp" "$RDV"',
+            "fi",
+            "for _i in $(seq 600); do",
+            '  [ -f "$RDV" ] && break',
+            "  sleep 1",
+            "done",
+            'if [ ! -f "$RDV" ]; then',
+            '  echo "launch.py[sge]: rendezvous file $RDV never appeared'
+            ' (is -cwd on a shared filesystem?)" >&2',
+            "  exit 1",
+            "fi",
+            'export MXNET_TPU_COORDINATOR="$(cat "$RDV"):%d"' % port,
+        ]
+    lines += [joined, ""]
+    return "\n".join(lines)
 
 
 def launch_sge(n, cmd, dry_run=False):
