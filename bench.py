@@ -1,4 +1,5 @@
-"""Benchmark: ResNet-50 training, single chip — headline metric is MFU.
+"""Benchmark: ResNet-50 + transformer-LM training, single chip — headline
+metric is MFU.
 
 The reference's headline table is img/s (docs/how_to/perf.md:179-188,
 train_imagenet.py: P100 = 181.53 img/s @ bs32); this repo's north star
@@ -16,9 +17,24 @@ model and peak stated explicitly in the JSON:
   additionally reports median per-step wall time with a sync every step as
   a cross-check.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
-vs_baseline = MFU / 0.45 (the BASELINE.md north-star target) when MFU is
-computable, else img_per_sec / 181.53 (P100 reference row).
+Two workloads, both through the same fused-step methodology:
+
+- ResNet-50 @bs128 — the reference's headline table workload. On ONE v5e
+  its 1x1-conv family is bandwidth-bound and the model-level ceiling is
+  ~35-36% MFU (docs/perf.md roofline analysis); the 45% north star is
+  stated for v5p, where the same program is compute-bound.
+- Decoder transformer-LM @bs32 seq2048 (d_model 2048, GQA hkv=4, flash
+  attention fwd+bwd) — dot_general-dominated, compute-bound on v5e: the
+  workload that demonstrates north-star-class MFU on the chip this repo
+  can measure.
+
+The FINAL printed line (the driver's record) carries the transformer-LM
+headline with the ResNet record embedded alongside ("alongside" per the
+round-4 review); each workload's full record is also printed on its own
+line. vs_baseline = MFU / 0.45 (the BASELINE.md north-star target) when
+MFU is computable, else img_per_sec / 181.53 (P100 reference row).
+BENCH_MODEL=resnet|transformer restricts the run (the restricted
+workload's record is then the last line).
 
 Design: the whole training step is TWO jitted XLA computations fused into
 ONE program via Executor.make_train_step — forward+backward from the
@@ -33,6 +49,12 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# honor JAX_PLATFORMS even where sitecustomize force-registers the TPU
+# plugin (CI smoke runs set JAX_PLATFORMS=cpu)
+if os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+    _jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 # Default batch 128: the measured per-chip optimum on v5e (BENCH_SWEEP=1
 # table in docs/perf.md — bs128 beats bs256 by ~1.4pp MFU; the reference's
@@ -208,7 +230,160 @@ def _run_config_inner(batch, iters, repeats):
     return rec
 
 
+def run_transformer_config(batch=None, seq=None, iters=None, repeats=None,
+                           model_dim=2048, num_layers=4, vocab=10000,
+                           kv_heads=4):
+    """Transformer-LM training MFU via the EXACT ResNet methodology:
+    simple_bind + Executor.make_train_step (one fused XLA program:
+    fwd+bwd+SGD, donated buffers), analytic matmul FLOPs from flops.py
+    (FC projections + MultiHeadAttention at its USEFUL causal count),
+    median-of-N timed blocks, nominal bf16 peak denominator.
+
+    Default config bs32 x seq2048, d_model 2048 (16 heads x head_dim 128
+    — the flash kernel's native shape), GQA hkv=4, ffn 4x: the per-chip
+    MFU optimum from the docs/perf.md sweep; dot_general-dominated and
+    compute-bound on v5e."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import flops as flops_mod
+    from mxnet_tpu import models
+
+    batch = batch or int(os.environ.get("BENCH_LM_BATCH", "32"))
+    seq = seq or int(os.environ.get("BENCH_LM_SEQ", "2048"))
+    iters = iters or max(1, min(ITERS, 2048 // batch))
+    repeats = repeats or REPEATS
+    # CI smoke knobs (CPU backend): shrink the model, keep the code path
+    model_dim = int(os.environ.get("BENCH_LM_DIM", model_dim))
+    num_layers = int(os.environ.get("BENCH_LM_LAYERS", num_layers))
+    vocab = int(os.environ.get("BENCH_LM_VOCAB", vocab))
+    heads = model_dim // 128 if model_dim % 128 == 0 else max(
+        1, model_dim // 64)
+    kv_heads = min(kv_heads, heads)
+    while heads % kv_heads:  # GQA needs heads % kv_heads == 0
+        kv_heads -= 1
+    sym = models.get_symbol(
+        "transformer-lm", num_classes=vocab, num_layers=num_layers,
+        num_heads=heads, model_dim=model_dim, ffn_dim=4 * model_dim,
+        num_kv_heads=kv_heads, scalar_loss=True)
+    cdtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    arg_names = sym.list_arguments()
+    grad_req = {n: ("null" if n in ("data", "softmax_label") else "write")
+                for n in arg_names}
+    exe = sym.simple_bind(mx.Context("tpu", 0) if jax.default_backend() != "cpu"
+                          else mx.cpu(), grad_req=grad_req,
+                          compute_dtype=cdtype,
+                          data=(batch, seq), softmax_label=(batch, seq))
+    init = mx.initializer.Xavier(factor_type="in", magnitude=2.0)
+    for name, arr in exe.arg_dict.items():
+        if name in ("data", "softmax_label"):
+            continue
+        init(mx.initializer.InitDesc(name), arr)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randint(0, vocab, (batch, seq)).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, vocab, (batch, seq)).astype(np.float32))
+
+    lr, momentum, wd = 0.05, 0.9, 1e-4
+    param_names = [n for n in exe.arg_dict
+                   if n not in ("data", "softmax_label")]
+
+    def sgd_all(params, grads, moms):
+        new_p, new_m = {}, {}
+        for n in params:
+            g = grads[n] + wd * params[n]
+            m = momentum * moms[n] - lr * g
+            new_p[n] = params[n] + m
+            new_m[n] = m
+        return new_p, new_m
+
+    chain = max(1, int(os.environ.get("BENCH_CHAIN", "1")))
+    step = exe.make_train_step(sgd_all, chain=chain)
+    iters = max(1, iters // chain)
+    params = {n: jnp.array(exe.arg_dict[n]._data, copy=True)
+              for n in param_names}
+    moms = {n: jnp.zeros_like(v) for n, v in params.items()}
+    feed = {"data": x, "softmax_label": y}
+
+    def sync():
+        return np.asarray(jnp.reshape(outs[0], (-1,))[0])
+
+    for _ in range(WARMUP):
+        outs, params, moms = step(params, moms, feed)
+    sync()
+
+    block_times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            outs, params, moms = step(params, moms, feed)
+        sync()
+        block_times.append(time.perf_counter() - t0)
+    step_time = statistics.median(block_times) / (iters * chain)
+
+    tokens_per_sec = batch * seq / step_time
+    fwd_flops = flops_mod.count_flops(
+        sym, data=(batch, seq), softmax_label=(batch, seq))["total"]
+    train_flops = flops_mod.training_flops(fwd_flops)
+    peak, kind = flops_mod.chip_peak_flops()
+    if os.environ.get("BENCH_PEAK_TFLOPS"):
+        peak = float(os.environ["BENCH_PEAK_TFLOPS"]) * 1e12
+    achieved = train_flops / step_time
+    mfu = achieved / peak if (peak and cdtype == "bfloat16") else None
+
+    rec = {
+        "metric": "transformer_lm_train_mfu_bs%d_seq%d" % (batch, seq),
+        "batch": batch,
+        "seq": seq,
+        "value": round(100.0 * mfu, 2) if mfu is not None
+                 else round(tokens_per_sec, 1),
+        "unit": "percent_of_bf16_peak" if mfu is not None else "tokens/sec",
+        "vs_baseline": round(mfu / MFU_TARGET, 3) if mfu is not None else None,
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "step_time_ms": round(step_time * 1e3, 3),
+        "model": "decoder LM L=%d d_model=%d heads=%d gqa_kv=%d ffn=%d "
+                 "vocab=%d, flash attention, fused train step"
+                 % (num_layers, model_dim, heads, kv_heads, 4 * model_dim,
+                    vocab),
+        "flop_formula": "2 FLOPs/MAC over FC/attention matmuls (causal at "
+                        "useful count; fwd=%.3f GF/step), train=3x fwd"
+                        % (fwd_flops / 1e9),
+        "chip": kind,
+        "chip_peak_tflops": round(peak / 1e12, 1) if peak else None,
+        "achieved_tflops": round(achieved / 1e12, 2),
+        "timing": "median of %d blocks x %d dispatches x %d chained "
+                  "sub-steps, readback sync" % (repeats, iters, chain),
+        "compute_dtype": cdtype,
+    }
+    if mfu is None:
+        rec["metric"] = rec["metric"].replace("_mfu_", "_tokens_per_sec_")
+    return rec
+
+
 def main():
+    which = os.environ.get("BENCH_MODEL", "both")
+    if os.environ.get("BENCH_LM_SWEEP"):
+        # transformer (bs, seq) MFU table (docs/perf.md); one JSON line
+        # per config, headline (bs32, seq2048) re-printed last
+        rows = []
+        for batch, seq in [(8, 2048), (16, 2048), (32, 2048),
+                           (8, 4096), (16, 4096), (32, 1024)]:
+            try:
+                rec = run_transformer_config(batch=batch, seq=seq,
+                                             repeats=3)
+            except Exception as e:
+                rec = {"metric": "transformer_lm_train_mfu_bs%d_seq%d"
+                                 % (batch, seq),
+                       "error": "%s: %s" % (type(e).__name__, e)}
+            rows.append(rec)
+            print(json.dumps(rec), flush=True)
+        ok = [r for r in rows if "error" not in r]
+        head = next((r for r in ok
+                     if r.get("batch") == 32 and r.get("seq") == 2048),
+                    ok[0] if ok else rows[-1])
+        print(json.dumps(head))
+        return
     if os.environ.get("BENCH_SWEEP"):
         # MFU-vs-batch table (one JSON line per config; the HEADLINE
         # config's line is re-printed LAST so the driver's
@@ -242,7 +417,25 @@ def main():
                   file=sys.stderr)
         print(json.dumps(headline))
         return
-    print(json.dumps(run_config(BATCH)))
+    if which == "resnet":
+        print(json.dumps(run_config(BATCH)))
+        return
+    if which == "transformer":
+        print(json.dumps(run_transformer_config()))
+        return
+    # default: BOTH workloads; each full record on its own line, then the
+    # driver-facing final line = the transformer-LM headline (the
+    # compute-bound, north-star-class number on this chip) with the
+    # ResNet record embedded alongside
+    resnet = run_config(BATCH)
+    print(json.dumps(resnet), flush=True)
+    lm = run_transformer_config()
+    print(json.dumps(lm), flush=True)
+    final = dict(lm)
+    final["resnet50"] = {k: resnet[k] for k in
+                         ("metric", "value", "unit", "vs_baseline",
+                          "img_per_sec", "step_time_ms") if k in resnet}
+    print(json.dumps(final))
 
 
 if __name__ == "__main__":

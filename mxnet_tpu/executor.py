@@ -279,8 +279,14 @@ class Executor:
                 outs = [o[-1] for o in outs_seq]  # last sub-step's outputs
                 return outs, p, s, aux
 
-        use_auto = (jax.default_backend() == "tpu" and os.environ.get(
-            "MXNET_STEP_AUTO_LAYOUT", "1") != "0")
+        # gate on THIS executor's device, not the process default backend:
+        # a cpu-context Module in a tpu-default process (mixed setups,
+        # CPU data workers next to a chip) must not route cpu arrays
+        # through the TPU-only AUTO-layout compile
+        use_auto = (self._ctx.device_type in ("tpu", "gpu")
+                    and jax.default_backend() == "tpu"
+                    and os.environ.get(
+                        "MXNET_STEP_AUTO_LAYOUT", "1") != "0")
         jitted = None if use_auto else jax.jit(step, donate_argnums=(0, 1))
         aot = {}  # compiled, in_formats (built on first call)
 

@@ -27,7 +27,9 @@ KVStore object is:
 """
 from __future__ import annotations
 
+import os
 import pickle
+import threading
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -203,11 +205,11 @@ class PSKVStore(KVStore):
         from . import engine
         from .kvstore_server import PSClient, num_workers
 
-        self._client = PSClient()
         self._n_workers = num_workers()
-        self._rank = int(__import__("os").environ.get(
-            "MXNET_TPU_WORKER_RANK",
-            __import__("os").environ.get("DMLC_WORKER_ID", "0")))
+        self._rank = int(os.environ.get(
+            "MXNET_TPU_WORKER_RANK", os.environ.get("DMLC_WORKER_ID", "0")))
+        # rank-tagged client: sync merges dedupe per sender (recovery)
+        self._client = PSClient(rank=self._rank)
         # PS RPCs are engine ops with one var per key (the reference's
         # KVStoreDist: ZPush/ZPull run on the engine holding the buffer
         # vars, kvstore_dist.h:233-241) — pushes return immediately and
@@ -216,8 +218,28 @@ class PSKVStore(KVStore):
         self._engine = engine
         self._key_vars = {}
         self._rpc_errs = []
-        self._errs_lock = __import__("threading").Lock()
-        if self._rank == 0:
+        self._errs_lock = threading.Lock()
+        # liveness registration (ps-lite heartbeat analogue): hello on the
+        # control channel tells the server this rank is up; the reply says
+        # whether this is a RECOVERY (the rank was registered before and
+        # its connection dropped — reference kvstore_dist.h:39-42). A
+        # recovering worker skips the startup barrier (peers are mid-run
+        # and will not join it) and pulls current weights — the server's
+        # copy is authoritative.
+        self._recovery = (self._client.hello(self._rank) == "recovery"
+                          or bool(os.environ.get("MXNET_TPU_IS_RECOVERY")))
+        self._hb_stop = threading.Event()
+        hb = float(os.environ.get("MXNET_TPU_PS_HEARTBEAT", "2"))
+
+        def _heartbeat_loop():
+            while not self._hb_stop.wait(hb):
+                try:
+                    self._client.heartbeat(self._rank)
+                except Exception:
+                    return  # server gone; workers fail at the next RPC
+        if hb > 0:
+            threading.Thread(target=_heartbeat_loop, daemon=True).start()
+        if self._rank == 0 and not self._recovery:
             # rank-0 worker announces the consistency mode, as in
             # kvstore.cc:31-38 (kSyncMode command to servers)
             self._client.set_sync("async" not in kv_type)
@@ -306,12 +328,24 @@ class PSKVStore(KVStore):
         for k in keys:
             self._engine.get().wait_for_var(self._key_var(k))
         self._raise_pending()
+        # a completed pull means this worker holds current server weights:
+        # recovery is over, future barriers are real again
+        self._recovery = False
 
     def set_optimizer(self, optimizer):
         self._optimizer = optimizer
-        if self._rank == 0:
+        if self._rank == 0 and not self._recovery:
             self._client.set_optimizer(optimizer)
         self.barrier()
+
+    def num_dead_node(self, node_id=0, timeout_sec=60):
+        """Real liveness count from the server's heartbeat registry
+        (reference kvstore_dist.h:159-168 GetDeadNodes): workers whose
+        control connection dropped or whose heartbeat is older than
+        timeout_sec. Rides the dedicated control channel, so it works
+        while this worker's data connections are blocked in a sync-mode
+        merge — exactly when survivors need to ask."""
+        return len(self._client.dead_nodes(timeout_sec))
 
     def barrier(self):
         # flush every queued push/pull first: a barrier with RPCs still in
@@ -319,12 +353,25 @@ class PSKVStore(KVStore):
         for v in self._key_vars.values():
             self._engine.get().wait_for_var(v)
         self._raise_pending()
+        if self._recovery:
+            # startup barrier skip (reference is_recovery,
+            # kvstore_dist.h:77-79): the peers' startup barrier completed
+            # long ago; joining a fresh one would hang this worker AND
+            # poison the count for the peers' next real barrier
+            return
         self._client.barrier()
+
+    def finish_recovery(self):
+        """Called (or implied by the first completed pull) once a
+        recovering worker has the current weights: rejoin normal barrier
+        semantics."""
+        self._recovery = False
 
     def stop_server(self):
         for v in self._key_vars.values():
             self._engine.get().wait_for_var(v)
         self._raise_pending()
+        self._hb_stop.set()
         if self._rank == 0:
             self._client.stop()
 

@@ -154,13 +154,28 @@ class KVStoreServer:
         self.sync_mode = sync_mode
         self.store: Dict[Any, np.ndarray] = {}
         self.updater = None
-        self._merge: Dict[Any, np.ndarray] = {}
-        self._merge_count: Dict[Any, int] = {}
-        self._waiting: Dict[Any, list] = {}
+        # sync-mode merge state, per key. Rank-tagged pushes (the PS
+        # kvstore always tags) keep one contribution PER RANK so a worker
+        # that died after its push was merged and rejoins (recovery)
+        # REPLACES its stale contribution instead of being counted twice
+        # — latest-wins per sender, the ps-lite per-sender dedupe
+        # semantic. Untagged pushes (rank None, bare PSClient users)
+        # fall back to arrival counting as before.
+        self._merge_parts: Dict[Any, Dict[Any, np.ndarray]] = {}
+        self._merge_anon: Dict[Any, np.ndarray] = {}
+        self._merge_anon_count: Dict[Any, int] = {}
+        self._waiting: Dict[Any, list] = {}  # [(rank_or_None, conn)]
         self._barrier_conns: list = []
         self._q: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._ready = threading.Event()
+        # liveness registry (the ps-lite heartbeat/GetDeadNodes analogue,
+        # reference kvstore_dist.h:159-168): rank -> {conn, last_seen,
+        # dead_since}. Registration/heartbeats ride each worker's control
+        # connection; a dropped control connection marks the rank dead
+        # until it re-registers (hello), which is how a restarted worker
+        # is recognized as a recovery (kvstore_dist.h:39-42).
+        self._workers: Dict[int, Dict[str, Any]] = {}
 
     # --- request handling (single dispatch thread) ------------------------
     def _apply(self, key, merged):
@@ -180,17 +195,38 @@ class KVStoreServer:
             send_msg(conn, "ok")
         elif op == "push":
             key, val = req[1], req[2]
+            rank = req[3] if len(req) > 3 else None
             if self.sync_mode:
-                if key in self._merge:
-                    self._merge[key] += val
+                waiting = self._waiting.setdefault(key, [])
+                if rank is None:
+                    if key in self._merge_anon:
+                        self._merge_anon[key] += val
+                    else:
+                        self._merge_anon[key] = np.array(val, copy=True)
+                    self._merge_anon_count[key] = \
+                        self._merge_anon_count.get(key, 0) + 1
+                    waiting.append((None, conn))
                 else:
-                    self._merge[key] = np.array(val, copy=True)
-                self._merge_count[key] = self._merge_count.get(key, 0) + 1
-                self._waiting.setdefault(key, []).append(conn)
-                if self._merge_count[key] == self.n_workers:
-                    self._apply(key, self._merge.pop(key))
-                    self._merge_count[key] = 0
-                    for c in self._waiting.pop(key):
+                    parts = self._merge_parts.setdefault(key, {})
+                    if rank in parts:
+                        # duplicate from the same sender (a recovered
+                        # worker re-pushing the round its first attempt
+                        # died in): replace, don't double-count — and
+                        # drop the dead attempt's waiting reply slot
+                        waiting[:] = [(r, c) for r, c in waiting
+                                      if r != rank]
+                    parts[rank] = np.array(val, copy=True)
+                    waiting.append((rank, conn))
+                n_got = (len(self._merge_parts.get(key, {}))
+                         + self._merge_anon_count.get(key, 0))
+                if n_got == self.n_workers:
+                    merged = self._merge_anon.pop(key, None)
+                    for part in self._merge_parts.pop(key, {}).values():
+                        merged = (np.array(part, copy=True)
+                                  if merged is None else merged + part)
+                    self._merge_anon_count[key] = 0
+                    self._apply(key, merged)
+                    for _, c in self._waiting.pop(key):
                         # one dead worker's connection must not abort
                         # the replies to the LIVE waiters
                         try:
@@ -226,6 +262,42 @@ class KVStoreServer:
                     except (OSError, EOFError, BrokenPipeError):
                         pass
                 self._barrier_conns = []
+        elif op == "hello":
+            # worker registration on its control connection. A rank that
+            # was seen before and is currently dead (conn dropped) comes
+            # back as a RECOVERY — the reply tells the worker to skip the
+            # startup barrier and pull current weights (server weights
+            # are authoritative, reference kvstore_dist.h:39-42,77-79).
+            rank = int(req[1])
+            w = self._workers.get(rank)
+            is_recovery = bool(w) and (w.get("dead_since") is not None
+                                       or w.get("conn") is not conn)
+            self._workers[rank] = {"conn": conn, "last_seen": time.time(),
+                                   "dead_since": None}
+            send_msg(conn, "ok", "recovery" if is_recovery else "welcome")
+        elif op == "heartbeat":
+            rank = int(req[1])
+            w = self._workers.get(rank)
+            if w is not None and w.get("conn") is conn:
+                w["last_seen"] = time.time()
+                w["dead_since"] = None
+            send_msg(conn, "ok")
+        elif op == "dead_nodes":
+            # GetDeadNodes(timeout): ranks whose control connection
+            # dropped (and no re-hello yet) or whose last heartbeat is
+            # older than timeout seconds
+            timeout = float(req[1])
+            now = time.time()
+            dead = sorted(rank for rank, w in self._workers.items()
+                          if w.get("dead_since") is not None
+                          or now - w.get("last_seen", now) > timeout)
+            send_msg(conn, "ok", dead)
+        elif op == "__disconnect__":
+            # internal: a reader thread saw EOF on `conn`; if it was a
+            # registered worker's control connection, mark the rank dead
+            for w in self._workers.values():
+                if w.get("conn") is conn and w.get("dead_since") is None:
+                    w["dead_since"] = time.time()
         elif op == "stop":
             send_msg(conn, "ok")
             self._stop.set()
@@ -239,7 +311,9 @@ class KVStoreServer:
                 req = recv_msg(conn)
                 self._q.put((conn, req))
         except (EOFError, OSError):
-            pass
+            # liveness: let the dispatch thread mark the rank (if any)
+            # whose control connection this was
+            self._q.put((conn, ("__disconnect__",)))
 
     def _accept_loop(self, listener):
         while not self._stop.is_set():
@@ -315,10 +389,14 @@ class PSClient:
     near-equal contiguous ranges, one per server, so no single server
     carries a whole embedding-sized tensor."""
 
-    def __init__(self, addresses=None):
+    def __init__(self, addresses=None, rank=None):
         if (isinstance(addresses, tuple) and len(addresses) == 2
                 and isinstance(addresses[0], str)):
             addresses = [addresses]  # single (host, port)
+        # rank tags this client's sync-mode pushes so the server merges
+        # one contribution PER SENDER (latest wins — recovery-safe);
+        # None (bare clients) falls back to arrival counting
+        self.rank = rank
         self.addresses = addresses or _uris()
         if not self.addresses:
             raise MXNetError(
@@ -329,6 +407,12 @@ class PSClient:
         # per-connection locks: a slow-to-bind server's connect retry must
         # not block RPCs to servers that are already up
         self._locks = [threading.Lock() for _ in self.addresses]
+        # dedicated CONTROL connection to server 0 for hello/heartbeat/
+        # dead_nodes (the ps-lite van/heartbeat channel analogue): liveness
+        # queries must work while a sync-mode push is BLOCKED holding a
+        # data connection's lock — that is exactly when survivors ask
+        self._ctrl = None
+        self._ctrl_lock = threading.Lock()
 
     @property
     def n_servers(self) -> int:
@@ -422,10 +506,11 @@ class PSClient:
         v = np.ascontiguousarray(value)
         plan = self._plan(key, v.size)
         if plan is None:
-            self._rpc(self._server_of(key), "push", key, v)
+            self._rpc(self._server_of(key), "push", key, v, self.rank)
             return
         flat = v.reshape(-1)
-        self._sharded_rpc([(sid, ("push", (key, "part", sid), flat[lo:hi]))
+        self._sharded_rpc([(sid, ("push", (key, "part", sid), flat[lo:hi],
+                                  self.rank))
                            for sid, lo, hi in plan])
 
     def pull(self, key, size=None) -> np.ndarray:
@@ -438,6 +523,39 @@ class PSClient:
         parts = self._sharded_rpc([(sid, ("pull", (key, "part", sid)))
                                    for sid, lo, hi in plan])
         return np.concatenate([np.asarray(p).reshape(-1) for p in parts])
+
+    def _ctrl_rpc(self, *req):
+        with self._ctrl_lock:
+            if self._ctrl is None:
+                deadline = time.time() + float(os.environ.get(
+                    "MXNET_TPU_PS_CONNECT_TIMEOUT", "60"))
+                while True:
+                    try:
+                        self._ctrl = Client(self.addresses[0], authkey=_AUTH)
+                        break
+                    except (ConnectionRefusedError, FileNotFoundError,
+                            OSError):
+                        if time.time() > deadline:
+                            raise
+                        time.sleep(0.2)
+            send_msg(self._ctrl, *req)
+            resp = recv_msg(self._ctrl)
+        return self._check(resp)
+
+    def hello(self, rank: int) -> str:
+        """Register this worker's liveness on the control channel; returns
+        "welcome" (first join) or "recovery" (this rank was seen before
+        and is currently dead — skip the startup barrier and pull current
+        weights, reference kvstore_dist.h:39-42)."""
+        return self._ctrl_rpc("hello", int(rank))
+
+    def heartbeat(self, rank: int):
+        self._ctrl_rpc("heartbeat", int(rank))
+
+    def dead_nodes(self, timeout_sec: float = 60):
+        """Ranks currently considered dead (dropped control connection or
+        stale heartbeat) — reference GetDeadNodes, kvstore_dist.h:159."""
+        return list(self._ctrl_rpc("dead_nodes", float(timeout_sec)))
 
     def set_optimizer(self, optimizer):
         blob = pickle.dumps(optimizer)

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Worker-failure + recovery validation for the PS path, closed-form.
+
+The capability mirrored from the reference (kvstore_dist.h:159-168
+GetDeadNodes liveness, :39-42,77-79 is_recovery rejoin with the server
+holding authoritative weights): one of N sync workers is KILLED
+mid-training, the survivors observe ``kv.num_dead_node() == 1`` while
+their next merge waits, the worker is restarted, auto-detected as a
+recovery (hello on the control channel), skips the startup barrier,
+pulls the current weights to learn where training stands, and the run
+completes with the exact closed-form final value.
+
+Closed form: each worker pushes (rank+1)-scaled ones per round under the
+Test optimizer (weight += merged), so after round r the value is
+r * sum(rank+1). The recovered worker reads the value to find the last
+completed round — the weights themselves carry the resume point, as with
+reference checkpoint-free PS recovery.
+
+Env (driven by tests/test_dist_multiprocess.py):
+  MXNET_TPU_KILL_AFTER_ROUND=k  victim exits(42) after completing round k
+  MXNET_TPU_VICTIM_RANK=r       which rank is the victim
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+KEY = 3
+SHAPE = (4, 4)
+ROUNDS = 6
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import kvstore_server
+
+    if kvstore_server.role() == "server":
+        kvstore_server.run()
+        return
+
+    rank = int(os.environ["MXNET_TPU_WORKER_RANK"])
+    n = int(os.environ["MXNET_TPU_NUM_WORKERS"])
+    victim = int(os.environ.get("MXNET_TPU_VICTIM_RANK", "-1"))
+    kill_after = int(os.environ.get("MXNET_TPU_KILL_AFTER_ROUND", "0"))
+    scale = sum(r + 1 for r in range(n))
+
+    kv = mx.kvstore.create("dist_sync")
+    recovering = kv._recovery
+    if recovering:
+        print("worker %d REJOINED as recovery" % rank, flush=True)
+    # set_optimizer before any pull: a pull completes recovery (real
+    # barriers resume), and set_optimizer's internal barrier must still
+    # be skipped while the peers are mid-run
+    kv.set_optimizer(mx.optimizer.Test(rescale_grad=1.0))
+    kv.init(KEY, mx.nd.zeros(SHAPE))  # first-init-wins: no-op on rejoin
+
+    # where does training stand? the server's weights say (value = r*scale)
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(KEY, out=out)
+    done = int(round(float(out.asnumpy().flat[0]) / scale))
+    start = done + 1
+    if recovering:
+        assert done == kill_after, (done, kill_after)
+        assert not kv._recovery, "pull should complete recovery"
+    else:
+        assert done == 0, done
+
+    for rnd in range(start, ROUNDS + 1):
+        kv.push(KEY, mx.nd.ones(SHAPE) * (rank + 1))
+        if rank == victim and not recovering and rnd == kill_after:
+            # pull acks the merge (engine-ordered after the push), so the
+            # kill lands on a round boundary — no partial contribution
+            kv.pull(KEY, out=out)
+            assert float(out.asnumpy().flat[0]) == rnd * scale
+            print("worker %d dying after round %d" % (rank, rnd), flush=True)
+            os._exit(42)
+        if rank != victim and rnd == kill_after + 1 and victim >= 0:
+            # survivors: the round-(k+1) merge is waiting on the dead
+            # worker — observe the failure via the control channel (the
+            # data path is blocked, which is exactly the point)
+            deadline = time.time() + 60
+            while kv.num_dead_node(timeout_sec=30) != 1:
+                assert time.time() < deadline, "never saw the dead worker"
+                time.sleep(0.2)
+            print("worker %d SAW_DEAD=1" % rank, flush=True)
+
+    # the final pulls only complete once every worker (incl. the
+    # recovered one) contributed all rounds
+    kv.pull(KEY, out=out)
+    got = out.asnumpy()
+    want = np.full(SHAPE, float(ROUNDS * scale), np.float32)
+    assert np.array_equal(got, want), (got.flat[:4], want.flat[:4])
+
+    # liveness restored: nobody is dead once the victim re-registered
+    deadline = time.time() + 60
+    while kv.num_dead_node(timeout_sec=30) != 0:
+        assert time.time() < deadline, "dead count never recovered to 0"
+        time.sleep(0.2)
+
+    kv.barrier()  # everyone (incl. recovered worker) joins a REAL barrier
+    if rank == 0:
+        kv.stop_server()
+    print("worker %d OK (recovery closed-form, %d rounds)" % (rank, ROUNDS),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

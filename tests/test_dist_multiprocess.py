@@ -142,6 +142,111 @@ def test_dist_sync_kvstore_two_servers():
                 p.kill()
 
 
+def test_dist_kvstore_failure_recovery():
+    """A worker dies mid-sync-training and REJOINS (reference ps-lite
+    heartbeats + is_recovery, kvstore_dist.h:159-168, 39-42, 77-79):
+    survivors observe num_dead_node()==1 over the control channel while
+    their merge waits, the restarted worker auto-detects recovery (skips
+    the startup barrier, pulls current weights), and the closed-form
+    final value still holds exactly."""
+    script = os.path.join(REPO, "tests", "nightly",
+                          "dist_recovery_kvstore.py")
+    n_workers = 3
+    victim = 2
+    uri = "127.0.0.1:%d" % _free_port()
+    base = dict(os.environ,
+                JAX_PLATFORMS="cpu",
+                MXNET_TPU_PS_URI=uri,
+                MXNET_TPU_NUM_WORKERS=str(n_workers),
+                MXNET_TPU_VICTIM_RANK=str(victim),
+                MXNET_TPU_KILL_AFTER_ROUND="2")
+
+    server = subprocess.Popen(
+        [sys.executable, script],
+        env=dict(base, MXNET_TPU_ROLE="server"),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    host, port = uri.split(":")
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if server.poll() is not None:
+            out, _ = server.communicate()
+            raise AssertionError("server died at startup:\n%s" % out[-3000:])
+        try:
+            socket.create_connection((host, int(port)), timeout=1).close()
+            break
+        except OSError:
+            time.sleep(0.3)
+    else:
+        raise AssertionError("server never bound %s" % uri)
+
+    def spawn(rank):
+        return subprocess.Popen(
+            [sys.executable, script],
+            env=dict(base, MXNET_TPU_ROLE="worker",
+                     MXNET_TPU_WORKER_RANK=str(rank)),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+    workers = {r: spawn(r) for r in range(n_workers)}
+    restarted = None
+    try:
+        # 1. the victim must die with its marker exit code
+        out_v, _ = workers[victim].communicate(timeout=240)
+        assert workers[victim].returncode == 42, (
+            "victim rc=%s:\n%s" % (workers[victim].returncode, out_v[-3000:]))
+        assert "dying after round 2" in out_v
+
+        # 2. both survivors observe the death via num_dead_node()==1
+        #    (their stdout prints SAW_DEAD=1 before they proceed); poll
+        #    the pipes WITHOUT closing them (raw non-blocking reads — the
+        #    text-mode wrapper cannot handle a non-blocking fd)
+        saw = {r: "" for r in workers if r != victim}
+
+        def drain(r):
+            try:
+                chunk = os.read(workers[r].stdout.fileno(), 65536)
+            except BlockingIOError:
+                return
+            if chunk:
+                saw[r] += chunk.decode("utf-8", "replace")
+
+        deadline = time.time() + 120
+        for r in list(saw):
+            os.set_blocking(workers[r].stdout.fileno(), False)
+        while time.time() < deadline and not all(
+                "SAW_DEAD=1" in t for t in saw.values()):
+            for r in saw:
+                drain(r)
+                assert workers[r].poll() is None or "SAW_DEAD=1" in saw[r], (
+                    "survivor %d exited early:\n%s" % (r, saw[r]))
+            time.sleep(0.2)
+        assert all("SAW_DEAD=1" in t for t in saw.values()), saw
+
+        # 3. restart the victim: hello auto-detects recovery, training
+        #    completes with the exact closed-form value on every worker
+        restarted = spawn(victim)
+        out_r, _ = restarted.communicate(timeout=240)
+        assert restarted.returncode == 0, (
+            "restarted worker failed:\n%s" % out_r[-3000:])
+        assert "REJOINED as recovery" in out_r
+        assert "OK (recovery closed-form" in out_r
+        deadline = time.time() + 120
+        for r in list(saw):
+            while workers[r].poll() is None and time.time() < deadline:
+                drain(r)
+                time.sleep(0.2)
+            drain(r)
+            assert workers[r].returncode == 0, (
+                "survivor %d failed:\n%s" % (r, saw[r][-3000:]))
+            assert "OK (recovery closed-form" in saw[r]
+        server.communicate(timeout=60)
+        assert server.returncode == 0
+    finally:
+        for p in list(workers.values()) + [server] + (
+                [restarted] if restarted else []):
+            if p.poll() is None:
+                p.kill()
+
+
 def test_resource_manager_rank_mappings(monkeypatch):
     """dist.init's rank/world fallback reads whatever resource manager
     launched the process (the env the reference's dmlc trackers fed via

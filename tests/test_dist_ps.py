@@ -3,6 +3,7 @@ nightly dist kvstore tests with closed-form integer arithmetic
 (tests/nightly/dist_sync_kvstore.py:14-45, SURVEY §4.6), run in-process:
 one server thread + N worker client threads over real sockets."""
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -98,6 +99,81 @@ def test_ps_barrier_and_default_assign():
     t2.join(timeout=10)
     np.testing.assert_allclose(c1.pull("x"), np.full(3, 3.0))
     c1.stop()
+
+
+def test_ps_liveness_registry():
+    """hello/heartbeat/dead_nodes semantics (reference ps-lite heartbeats
+    + GetDeadNodes + is_recovery, kvstore_dist.h:159-168, 39-42): a
+    registered worker whose control connection drops is reported dead; a
+    re-hello of the same rank is answered "recovery" and clears it; a
+    stale heartbeat also counts as dead under a short timeout."""
+    import time as _time
+
+    addr = ("127.0.0.1", _free_port())
+    server = KVStoreServer(address=addr, n_workers=2, sync_mode=True)
+    server.start_background()
+
+    c0, c1 = PSClient(addr), PSClient(addr)
+    assert c0.hello(0) == "welcome"
+    assert c1.hello(1) == "welcome"
+    assert c0.dead_nodes(timeout_sec=30) == []
+
+    # worker 1's control connection drops (process death analogue)
+    c1._ctrl.close()
+    deadline = _time.time() + 10
+    while c0.dead_nodes(timeout_sec=30) != [1]:
+        assert _time.time() < deadline, c0.dead_nodes(timeout_sec=30)
+        _time.sleep(0.05)
+
+    # restart: same rank re-registers on a fresh control connection
+    c1b = PSClient(addr)
+    assert c1b.hello(1) == "recovery"
+    assert c0.dead_nodes(timeout_sec=30) == []
+
+    # stale heartbeat: with a tiny timeout and no traffic, both count as
+    # dead; one heartbeat revives rank 0
+    _time.sleep(0.3)
+    assert 0 in c0.dead_nodes(timeout_sec=0.1)
+    c0.heartbeat(0)
+    assert 0 not in c0.dead_nodes(timeout_sec=10)
+    c0.stop()
+
+
+def test_ps_sync_merge_dedupes_per_rank():
+    """Rank-tagged sync pushes merge ONE contribution per sender, latest
+    wins: a recovered worker re-pushing the round its first attempt died
+    in must not be counted twice (the reference's per-sender dedupe).
+    The replaced value — not the stale one — enters the merge."""
+    addr = ("127.0.0.1", _free_port())
+    server = KVStoreServer(address=addr, n_workers=2, sync_mode=True)
+    server.start_background()
+    c0 = PSClient(addr, rank=0)
+    c0.init("w", np.zeros((3,), np.float32))
+
+    # worker 1's first attempt pushes 10s and dies before the merge
+    # completes (no ack wait: fire the RPC from a thread and abandon it)
+    dead = PSClient(addr, rank=1)
+    # daemon: the abandoned attempt's reply slot is (correctly) dropped
+    # by the replacement, so this thread never unblocks — it must not
+    # keep the interpreter alive at exit
+    t_dead = threading.Thread(
+        target=lambda: dead.push("w", np.full((3,), 10.0, np.float32)),
+        daemon=True)
+    t_dead.start()
+    time.sleep(0.3)  # let the push reach the merge buffer
+
+    # restarted worker 1 re-pushes DIFFERENT values (recomputed)
+    c1 = PSClient(addr, rank=1)
+    t1 = threading.Thread(
+        target=lambda: c1.push("w", np.full((3,), 2.0, np.float32)))
+    t1.start()
+    time.sleep(0.2)
+    # rank 0 completes the round: merge must be 1.0 + 2.0 (replacement),
+    # not 1.0 + 10.0 + 2.0 (double count) nor 1.0 + 10.0 (stale wins)
+    c0.push("w", np.ones((3,), np.float32))
+    t1.join(timeout=10)
+    np.testing.assert_allclose(c0.pull("w"), np.full(3, 3.0))
+    c0.stop()
 
 
 def test_ps_kvstore_worker_facade(monkeypatch):

@@ -70,6 +70,28 @@ def test_adversary_fgsm_example():
     assert "fgsm OK" in out
 
 
+def test_bench_transformer_headline_smoke():
+    """bench.py's transformer-LM headline path (the round-5 BENCH
+    record) runs end-to-end at CI size on the CPU backend: symbol build
+    with GQA + scalar loss, fused make_train_step, FLOP accounting, and
+    the JSON record contract (tokens/sec fallback where MFU has no
+    denominator)."""
+    import json
+    env = dict(ENV, BENCH_MODEL="transformer", BENCH_LM_BATCH="2",
+               BENCH_LM_SEQ="64", BENCH_LM_DIM="128", BENCH_LM_LAYERS="1",
+               BENCH_LM_VOCAB="128", BENCH_ITERS="2", BENCH_REPEATS="1")
+    proc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                          capture_output=True, text=True, timeout=600,
+                          env=env, cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["metric"].startswith("transformer_lm_train_")
+    assert rec["batch"] == 2 and rec["seq"] == 64
+    # CPU backend: no bf16 peak -> throughput record, not a bogus MFU
+    assert rec["unit"] == "tokens/sec" and rec["value"] > 0
+    assert "flash" in rec["model"]
+
+
 def test_bench_lstm_example():
     """Pallas-selection microbench + PTB LM throughput paths, incl. the
     scalar-loss head symbol."""
