@@ -37,14 +37,11 @@ def get_iters(args):
             label=os.path.join(args.data_dir, "t10k-labels-idx1-ubyte"),
             batch_size=args.batch_size, shuffle=False, flat=flat)
         return train, val
-    # synthetic fallback: 10 gaussian blobs in pixel space
-    rng = np.random.RandomState(0)
+    # synthetic fallback: the shared 10-gaussian-blob task
+    # (mx.test_utils.synthetic_digits — same definition the CI
+    # convergence bars are calibrated on)
     n = 4096
-    centers = rng.uniform(0, 1, (10, 28 * 28)).astype(np.float32)
-    y = rng.randint(0, 10, n)
-    X = centers[y] + 0.3 * rng.randn(n, 28 * 28).astype(np.float32)
-    if not flat:
-        X = X.reshape(n, 1, 28, 28)
+    X, y = mx.test_utils.synthetic_digits(n, flat=flat)
     split = n * 7 // 8
     train = mx.io.NDArrayIter(X[:split], y[:split].astype(np.float32),
                               batch_size=args.batch_size, shuffle=True,
@@ -70,9 +67,11 @@ def main():
     args = p.parse_args()
 
     import jax
+    import numpy as np
     import mxnet_tpu as mx
     from mxnet_tpu import models
 
+    np.random.seed(0)  # deterministic param init (CI quality bars)
     train, val = get_iters(args)
     sym = models.get_symbol(args.network, num_classes=10)
     dev = (mx.Context("tpu", 0) if jax.default_backend() != "cpu"

@@ -91,6 +91,7 @@ def main():
     args = p.parse_args()
 
     import numpy as np
+    np.random.seed(0)  # deterministic param init (CI quality bars)
     import mxnet_tpu as mx
 
     S, stride = args.image_size, args.feat_stride

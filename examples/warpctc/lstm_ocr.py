@@ -78,6 +78,7 @@ def main():
     args = p.parse_args()
 
     import numpy as np
+    np.random.seed(0)  # deterministic param init (CI quality bars)
 
     import mxnet_tpu as mx
 

@@ -161,3 +161,20 @@ def check_consistency(sym, ctx_list, scale=1.0, grad_req="write", rtol=1e-3, ato
         for k in ref_grads:
             np.testing.assert_allclose(ref_grads[k], grads[k], rtol=rtol, atol=atol)
     return results
+
+
+def synthetic_digits(n, flat=True, noise=0.3, seed=0, num_classes=10):
+    """Seeded MNIST-stand-in: 10 gaussian blobs in 28x28 pixel space
+    (zero-egress CI has no real MNIST; the reference's convergence bars
+    — tests/python/train/test_mlp.py:65 acc>0.95 — are applied to this
+    deterministic task instead). Returns (X, y): X is (n, 784) when
+    flat else (n, 1, 28, 28), y is int labels. Shared by the
+    train_mnist example, tests/test_convergence.py, and
+    tests/test_models.py so the task cannot drift between them."""
+    rng = np.random.RandomState(seed)
+    centers = rng.uniform(0, 1, (num_classes, 28 * 28)).astype(np.float32)
+    y = rng.randint(0, num_classes, n)
+    X = centers[y] + noise * rng.randn(n, 28 * 28).astype(np.float32)
+    if not flat:
+        X = X.reshape(n, 1, 28, 28)
+    return X, y

@@ -1,6 +1,7 @@
 """Smoke tests for the examples/ layer (reference L8, SURVEY §1):
 each example must run end-to-end on the virtual CPU mesh."""
 import os
+import re
 import subprocess
 import sys
 
@@ -18,10 +19,29 @@ def _run(script, *args, timeout=600):
     return proc.stdout
 
 
+def _loss_ratio(out):
+    """last/first from the examples' \"loss A -> B\" summary line. The
+    numeric bars below replace bare \"decreasing\" asserts (round-4
+    review: a regression that halves learning quality must FAIL CI, and
+    'loss dropped once' is not a quality gate). Bars carry margin above
+    the measured seeded-run ratios."""
+    m = re.findall(r"loss ([0-9.]+) -> ([0-9.]+)", out)
+    assert m, "no 'loss A -> B' summary in output:\n%s" % out[-1000:]
+    first, last = float(m[-1][0]), float(m[-1][1])
+    assert first > 0, out
+    return last / first
+
+
 def test_train_mnist_example():
     out = _run("examples/image-classification/train_mnist.py",
                "--num-epochs", "2", "--batch-size", "64")
     assert "final validation" in out
+    # numpy>=2 prints [('accuracy', np.float64(1.0))], numpy<2 prints
+    # [('accuracy', 1.0)] — match the value, not the repr (accuracy is
+    # in [0, 1], so the leading digit is 0 or 1 and the float64 "64"
+    # cannot false-match)
+    m = re.search(r"final validation.*?accuracy.*?([01]\.[0-9]+)", out)
+    assert m and float(m.group(1)) > 0.95, out  # measured 1.0 (synthetic)
 
 
 def test_ring_attention_example():
@@ -46,6 +66,7 @@ def test_ssd_train_example():
     ImageDetRecordIter -> MultiBoxTarget -> loss decreasing."""
     out = _run("examples/ssd/train.py", "--steps", "12", "--image-size", "96")
     assert "decreasing" in out and "NOT decreasing" not in out
+    assert _loss_ratio(out) < 0.97, out  # measured 0.947 at these args
 
 
 def test_rcnn_train_example():
@@ -53,6 +74,7 @@ def test_rcnn_train_example():
     + masked smooth-L1 -> loss decreasing."""
     out = _run("examples/rcnn/train.py", "--steps", "12")
     assert "decreasing" in out and "NOT decreasing" not in out
+    assert _loss_ratio(out) < 0.88, out  # measured 0.787
 
 
 def test_autoencoder_example():
@@ -124,6 +146,7 @@ def test_warpctc_lstm_ocr_example():
     strings."""
     out = _run("examples/warpctc/lstm_ocr.py", "--steps", "8")
     assert "decreasing" in out and "NOT decreasing" not in out
+    assert _loss_ratio(out) < 0.55, out  # measured 0.34
 
 
 def test_nce_loss_example():
@@ -134,6 +157,7 @@ def test_nce_loss_example():
                "--vocab", "12000")
     assert "decreasing" in out and "NOT decreasing" not in out
     assert "vocab 12000" in out
+    assert _loss_ratio(out) < 0.995, out  # measured 0.984 (20 steps)
 
 
 def test_transformer_bench_example():
@@ -182,6 +206,9 @@ def test_fcn_segmentation_example():
     ignore_label, Mixed pattern-based init."""
     out = _run("examples/fcn-xs/fcn_segmentation.py", "--steps", "25")
     assert "decreasing" in out and "NOT decreasing" not in out
+    assert _loss_ratio(out) < 0.40, out  # measured 0.22
+    m = re.search(r"pixel acc ([0-9.]+)", out)
+    assert m and float(m.group(1)) > 0.85, out  # measured 0.934
 
 
 def test_recommender_example():

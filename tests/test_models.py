@@ -81,11 +81,9 @@ def test_lenet_convergence_synthetic():
     image task — the analogue of the reference's tests/python/train/
     test_conv.py convergence check."""
     mx.random.seed(7)
-    rng = np.random.RandomState(7)
     n = 512
-    centers = rng.uniform(0, 1, (10, 1, 28, 28)).astype(np.float32)
-    y = rng.randint(0, 10, n)
-    X = centers[y] + 0.25 * rng.randn(n, 1, 28, 28).astype(np.float32)
+    X, y = mx.test_utils.synthetic_digits(n, flat=False, noise=0.25,
+                                          seed=7)
     it = mx.io.NDArrayIter(X, y.astype(np.float32), batch_size=32,
                            shuffle=True, label_name="softmax_label")
     sym = models.get_symbol("lenet", num_classes=10)

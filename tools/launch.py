@@ -203,7 +203,10 @@ def launch_mpi(n, cmd, hostfile=None, dry_run=False):
     mpi_cmd = ["mpirun", "-np", str(n)]
     if hostfile:
         mpi_cmd += ["--hostfile", hostfile]
-    mpi_cmd += _mpi_env_flags("MXNET_TPU_COORDINATOR", coord) + cmd
+    # NUM_PROCS rides along for scripts that read it directly (rank
+    # itself comes from the MPI env: OMPI_COMM_WORLD_RANK / PMI_RANK)
+    mpi_cmd += (_mpi_env_flags("MXNET_TPU_COORDINATOR", coord)
+                + _mpi_env_flags("MXNET_TPU_NUM_PROCS", str(n)) + cmd)
     if dry_run:
         print(" ".join(shlex.quote(c) for c in mpi_cmd))
         return 0
