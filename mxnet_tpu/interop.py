@@ -289,3 +289,74 @@ def is_reference_symbol_json(data: dict) -> bool:
     doesn't."""
     attrs = data.get("attrs") or {}
     return "nodes" in data and "mxnet_tpu_version" not in attrs
+
+
+# write side -----------------------------------------------------------------
+
+_REV_OP_ALIASES = {v: k for k, v in _OP_ALIASES.items()}
+
+
+def _ref_attr_str(v) -> str:
+    """Python attr value -> the reference's dmlc::Parameter string
+    spelling ("(5,5)" tuples without spaces, "True"/"False", "None") —
+    the forms `coerce_attr` and the reference's own parsers both read."""
+    if v is None:
+        return "None"
+    if isinstance(v, bool):
+        return "True" if v else "False"
+    if isinstance(v, (tuple, list)):
+        return "(%s)" % ",".join(_ref_attr_str(x) for x in v)
+    return str(v)
+
+
+def save_symbol_json(sym, indent: int = 2) -> str:
+    """Emit reference-format symbol JSON (``nodes``/``arg_nodes``/
+    ``node_row_ptr``/``heads`` + ``attrs.mxnet_version`` — the schema
+    the reference's Symbol.save writes and legacy_json_util.cc reads;
+    the write-side complement of :func:`load_symbol_json`, closing the
+    ecosystem round trip the .params side already has).
+
+    Aux-state inputs are emitted as ordinary variable nodes (the >=0.9
+    convention); the reader reconstructs their aux positions from the
+    op's own aux-name list. Hidden keys (``__lr_mult__`` etc.) are
+    written wrapped, as the reference's C API stores them. Ops that
+    exist only in this framework serialize under their own names — this
+    repo's reader round-trips them; the reference era would reject them
+    exactly as it rejects any unknown op."""
+    nodes = sym._nodes()
+    idx = {id(n): i for i, n in enumerate(nodes)}
+    jnodes = []
+    for n in nodes:
+        jn = {"op": ("null" if n.is_var
+                     else _REV_OP_ALIASES.get(n.op.name, n.op.name)),
+              "name": n.name,
+              "inputs": [[idx[id(c)], i, 0] for c, i in n.inputs]}
+        attr = {}
+        if not n.is_var:
+            for k, v in n.attrs.items():
+                attr[k] = _ref_attr_str(v)
+            if n.op.variadic:
+                # the reference's variadic ops carry their input count
+                attr["num_args"] = str(len(n.inputs))
+        for k, v in (n.misc_attrs or {}).items():
+            attr[k] = v if isinstance(v, str) else _ref_attr_str(v)
+        if attr:
+            jn["attr"] = attr
+        jnodes.append(jn)
+    # node_row_ptr[i+1] = node_row_ptr[i] + num_outputs(node i): the
+    # entry-index table graph-runtime consumers use — a flat +1 per node
+    # would mis-index any graph with a multi-output op (SliceChannel...)
+    row_ptr = [0]
+    for n in nodes:
+        n_out = 1 if n.is_var else n.op.get_num_outputs(n.attrs)
+        row_ptr.append(row_ptr[-1] + n_out)
+    return json.dumps(
+        {
+            "nodes": jnodes,
+            "arg_nodes": [i for i, n in enumerate(nodes) if n.is_var],
+            "node_row_ptr": row_ptr,
+            "heads": [[idx[id(n)], i, 0] for n, i in sym._entries],
+            "attrs": {"mxnet_version": ["int", 905]},
+        },
+        indent=indent,
+    )

@@ -661,7 +661,19 @@ class Symbol:
         return eval_fn
 
     # --- save / load ------------------------------------------------------
-    def tojson(self) -> str:
+    def tojson(self, format: str = "native") -> str:
+        """Serialize the graph. format="native" (default) is this
+        repo's schema; format="reference" emits the reference
+        framework's nodes/arg_nodes/heads symbol JSON
+        (interop.save_symbol_json — readable by the reference era and
+        by this repo's own reader, the write-side complement of the
+        read interop)."""
+        if format == "reference":
+            from . import interop
+
+            return interop.save_symbol_json(self)
+        if format != "native":
+            raise ValueError("unknown symbol JSON format %r" % (format,))
         nodes = self._nodes()
         idx = {id(n): i for i, n in enumerate(nodes)}
         jnodes = []
@@ -693,9 +705,9 @@ class Symbol:
             indent=2,
         )
 
-    def save(self, fname: str):
+    def save(self, fname: str, format: str = "native"):
         with open(fname, "w") as f:
-            f.write(self.tojson())
+            f.write(self.tojson(format=format))
 
     def debug_str(self):
         lines = []

@@ -149,6 +149,50 @@ def test_warpctc_lstm_ocr_example():
     assert _loss_ratio(out) < 0.55, out  # measured 0.34
 
 
+def test_torch_module_example():
+    """Hybrid torch/mx training (reference example/torch/torch_module.py):
+    torch nn.Modules as Custom ops, mx autograd driving torch autograd,
+    torch optimizer stepping beside the mx loop."""
+    import pytest
+    pytest.importorskip("torch")
+    out = _run("examples/torch/torch_module.py", "--steps", "30")
+    assert "torch_module OK" in out
+    m = re.search(r"acc ([01]\.[0-9]+)", out)
+    assert m and float(m.group(1)) > 0.9, out  # measured 1.0
+
+
+def test_torch_function_example():
+    """Torch tensor math in mx graphs with exact gradients (reference
+    example/torch/torch_function.py)."""
+    import pytest
+    pytest.importorskip("torch")
+    out = _run("examples/torch/torch_function.py")
+    assert "torch_function OK" in out and "gradient check" in out
+
+
+def test_caffe_net_example():
+    """Caffe prototxt layers inside an mx network (reference
+    example/caffe/caffe_net.py), trained through Module against pycaffe
+    or the bundled contract stub."""
+    out = _run("examples/caffe/caffe_net.py")
+    assert "caffe_net OK" in out
+    m = re.search(r"acc ([01]\.[0-9]+)", out)
+    assert m and float(m.group(1)) > 0.9, out  # measured 1.0
+
+
+def test_speech_recognition_example():
+    """DeepSpeech-lite (reference example/speech_recognition): the one
+    family exercising bucketing + CTC + variable-length audio together —
+    conv time-stride front-end -> BiLSTM -> ctc_loss through
+    BucketingModule, both buckets sharing one parameter set."""
+    out = _run("examples/speech_recognition/train.py", "--steps", "6")
+    assert "deepspeech-lite OK: 2 buckets" in out
+    ratios = re.findall(r"bucket \d+: loss ([0-9.]+) -> ([0-9.]+)", out)
+    assert len(ratios) == 2
+    for first, last in ratios:
+        assert float(last) / float(first) < 0.75, out  # measured ~0.55
+
+
 def test_nce_loss_example():
     """NCE training at 10k+ vocab (reference example/nce-loss/toy_nce.py):
     Embedding gather/scatter backward at vocabulary scale, loss
