@@ -112,6 +112,7 @@ class Executor:
         self._monitored_rng = None
         self._rng_counter = 0
         self._last_rng = None
+        self._graph_needs_rng = None  # computed lazily on first use
 
     @staticmethod
     def _to_dict(values, names, what, allow_missing=False):
@@ -364,6 +365,22 @@ class Executor:
         return run
 
     def _next_rng(self):
+        if self._graph_needs_rng is None:
+            self._graph_needs_rng = any(
+                (not n.is_var) and n.op.needs_rng
+                for n in self._symbol._nodes())
+        if not self._graph_needs_rng and self._monitor_cb is None:
+            # no stochastic op consumes the key: reuse one key instead of
+            # paying jax.random.split's eager host cost (~2 ms) on EVERY
+            # forward/step — the dominant Python overhead of the fused
+            # fit step for deterministic graphs (docs/perf.md fit row).
+            # With a monitor installed the key must stay per-step fresh:
+            # _monitor_should_run dedupes fwd/bwd taps of one step by
+            # comparing key bytes, and a constant key would silence every
+            # tap after the first.
+            if self._last_rng is None:
+                self._last_rng = _random.next_key()
+            return self._last_rng
         self._last_rng = _random.next_key()
         return self._last_rng
 

@@ -97,6 +97,10 @@ class Optimizer:
         self.begin_num_update = begin_num_update
         self.num_update = begin_num_update
         self._index_update_count = {}
+        # bumped by set_lr_mult/set_wd_mult: fit_step's constant-lr cache
+        # fingerprints on it (in-place mutation of the mult dicts must go
+        # through the setters to be seen there)
+        self._mult_version = 0
         self.clip_gradient = clip_gradient
         if param_idx2name is None:
             param_idx2name = {}
@@ -152,11 +156,13 @@ class Optimizer:
 
     def set_lr_mult(self, args_lr_mult):
         self.lr_mult.update(args_lr_mult)
+        self._mult_version += 1
 
     def set_wd_mult(self, args_wd_mult):
         """Reference semantics (optimizer.py set_wd_mult): params whose name
         does not end in _weight/_gamma default to wd_mult 0, symbol attrs
         override, explicit args override both."""
+        self._mult_version += 1
         self.wd_mult = {}
         for n in self.idx2name.values():
             if not (n.endswith("_weight") or n.endswith("_gamma")):

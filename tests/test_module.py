@@ -195,6 +195,85 @@ def test_fused_fit_step_matches_unfused():
                                    atol=2e-5, err_msg=k)
 
 
+def test_fused_fit_step_matches_unfused_adam():
+    """Same fused-vs-unfused agreement under ADAM, whose effective lr
+    changes EVERY step (bias correction folded host-side): guards the
+    fused path's constant-lr fast cache against wrongly freezing a
+    count-dependent effective_lr_wd."""
+    import os
+    import numpy as np
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(5)
+    X = rng.uniform(-1, 1, (64, 10)).astype(np.float32)
+    w = rng.uniform(-1, 1, (10,)).astype(np.float32)
+    y = (X @ w > 0).astype(np.float32)
+
+    def build_and_fit():
+        it = mx.io.NDArrayIter(X, y, batch_size=16, shuffle=False,
+                               label_name="softmax_label")
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(net, context=mx.cpu())
+        mod.fit(it, num_epoch=3, optimizer="adam",
+                optimizer_params={"learning_rate": 0.01},
+                initializer=mx.initializer.Xavier(rnd_type="uniform",
+                                                  factor_type="avg",
+                                                  magnitude=2.0))
+        args, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in args.items()}
+
+    mx.random.seed(13)
+    fused = build_and_fit()
+    os.environ["MXNET_FUSED_FIT"] = "0"
+    try:
+        mx.random.seed(13)
+        unfused = build_and_fit()
+    finally:
+        del os.environ["MXNET_FUSED_FIT"]
+    for k in fused:
+        np.testing.assert_allclose(fused[k], unfused[k], rtol=2e-4,
+                                   atol=2e-5, err_msg=k)
+
+
+def test_fused_fit_lockstep_counts_materialize():
+    """The fused path's deferred (lockstep) update counts must
+    materialize into optimizer._index_update_count on any fused-state
+    exit — resume/save/scheduler installs read them."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(0)
+    X = rng.uniform(-1, 1, (32, 6)).astype(np.float32)
+    y = rng.randint(0, 2, (32,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16, label_name="softmax_label")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2),
+        name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    it.reset()
+    batch = next(iter(it))
+    for _ in range(5):
+        mod.fit_step(batch)
+    opt = mod._optimizer
+    assert opt.num_update == 5
+    mod._sync_fused_to_exec()  # any exit path (get_params/save/score)
+    counts = set(opt._index_update_count.values())
+    assert counts == {5}, counts
+    # a later unfused-style step keeps counting from there
+    mod.fit_step(batch)
+    mod._sync_fused_to_exec()
+    assert opt.num_update == 6
+    assert set(opt._index_update_count.values()) == {6}
+
+
 def test_fused_fit_then_score_and_checkpoint(tmp_path):
     """After fused fit, score() and save_checkpoint must see the trained
     (threaded/donated) parameters."""

@@ -64,3 +64,14 @@ def test_module_monitor_taps_every_output():
     assert any("relu1" in n for n in names), names
     # monitor disables the fused path (per-op taps need the unfused graph)
     assert mod._fused_fit is None or mod._fused_fit is False
+
+    # and it must keep tapping on EVERY subsequent step: the executor's
+    # cached-rng fast path for deterministic graphs must not be active
+    # with a monitor installed (the fwd/bwd dedupe compares key bytes —
+    # a constant key would silence all taps after step 1)
+    for _ in range(2):
+        mon.tic()
+        mod.forward_backward(batch)
+        mod.update()
+        again = mon.toc()
+        assert any("fc1" in n for _, n, _ in again), again
