@@ -257,6 +257,11 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        if self._fused_fit:
+            # force_rebind discards the fused state: flush its deferred
+            # lockstep counts first or _index_update_count permanently
+            # lags num_update (save/resume would serialize wrong t)
+            self._materialize_fused_counts(self._fused_fit)
         self._fused_fit = None
         self._fused_dirty = False
         self._fused_refresh = False

@@ -132,11 +132,23 @@ class SequentialModule(BaseModule):
                         inputs_need_grad=my_inputs_need_grad,
                         force_rebind=force_rebind, shared_module=None,
                         grad_req=grad_req)
-            my_data_shapes = [
-                (name, out.shape) for name, out in
-                zip(module.output_names, module.get_outputs())
-            ] if module._exec_group._exec.outputs else \
-                self._infer_shapes(module, my_data_shapes)
+            # next layer's input shapes = this layer's output shapes. A
+            # graph-backed Module infers them from its symbol; a
+            # PythonModule (no _exec_group, no symbol graph) declares
+            # them via its output_shapes contract (python_module.py
+            # _compute_output_shapes) — the reference's SequentialModule
+            # likewise consults output_shapes, not executor internals.
+            if hasattr(module, "_exec_group"):
+                my_data_shapes = ([
+                    (name, out.shape) for name, out in
+                    zip(module.output_names, module.get_outputs())
+                ] if module._exec_group._exec.outputs else
+                    self._infer_shapes(module, my_data_shapes))
+            else:
+                my_data_shapes = [
+                    (getattr(d, "name", d[0]),
+                     tuple(getattr(d, "shape", None) or d[1]))
+                    for d in module.output_shapes]
 
         if not anybody_ever_needs_label:
             self._label_shapes = None

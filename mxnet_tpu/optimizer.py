@@ -209,7 +209,11 @@ class Optimizer:
     # baked into pure_rule() at trace time and must invalidate caches.
     _DYNAMIC_OR_BOOKKEEPING = frozenset({
         "lr", "wd", "lr_scheduler", "lr_mult", "wd_mult", "idx2name",
-        "sym", "num_update", "begin_num_update", "_index_update_count"})
+        "sym", "num_update", "begin_num_update", "_index_update_count",
+        # mult-dict version: consumed by fit_step's cheap lw fingerprint;
+        # including it in the hyper key would turn every set_*_mult into
+        # a full fused-step rebuild instead of a one-off lw recompute
+        "_mult_version"})
 
     def _hyperparam_key(self):
         """Hashable tuple of every scalar hyperparameter closed over by

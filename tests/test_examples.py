@@ -149,6 +149,56 @@ def test_warpctc_lstm_ocr_example():
     assert _loss_ratio(out) < 0.55, out  # measured 0.34
 
 
+def test_module_api_walkthroughs():
+    """The reference example/module family: three-level API walkthrough,
+    SequentialModule across a module seam, and a numpy loss through
+    PythonLossModule — each converging to its bar."""
+    out = _run("examples/module/mnist_mlp.py", "--epochs", "3")
+    assert "module mnist_mlp OK" in out
+    out = _run("examples/module/sequential_module.py", "--epochs", "3")
+    assert "sequential_module OK" in out
+    out = _run("examples/module/python_loss.py")
+    assert "python_loss OK" in out
+
+
+def test_module_lstm_bucketing_example():
+    out = _run("examples/module/lstm_bucketing.py", "--epochs", "2")
+    assert "lstm_bucketing OK" in out
+
+
+def test_python_howto_examples():
+    """The reference example/python-howto walkthroughs: Group outputs,
+    single-op debugging, Monitor stats, custom DataIter."""
+    out = _run("examples/python-howto/multiple_outputs.py")
+    assert "multiple_outputs OK" in out
+    out = _run("examples/python-howto/debug_conv.py")
+    assert "debug_conv OK" in out
+    out = _run("examples/python-howto/monitor_weights.py")
+    assert "monitor_weights OK" in out and "stats tapped" in out
+    out = _run("examples/python-howto/data_iter.py")
+    assert "data_iter OK" in out
+
+
+def test_kaggle_ndsb_example():
+    """The Kaggle NDSB pipeline shape end-to-end: corpus -> .lst split ->
+    im2rec -> augmented ImageRecordIter -> train -> probability
+    submission CSV, converging past the bar."""
+    out = _run("examples/kaggle-ndsb1/train_dsb.py")
+    assert "kaggle-ndsb OK" in out
+    m = re.search(r"val acc ([01]\.[0-9]+)", out)
+    assert m and float(m.group(1)) > 0.85, out
+
+
+def test_speech_demo_decode_example():
+    """Decode side of the speech family (reference speech-demo):
+    greedy CTC decode over the logits tap, phoneme error rate under the
+    bar (measured 0.06)."""
+    out = _run("examples/speech-demo/decode_mxnet.py")
+    assert "speech-demo decode OK" in out
+    m = re.search(r"phoneme error rate ([0-9.]+)", out)
+    assert m and float(m.group(1)) <= 0.5, out
+
+
 def test_torch_module_example():
     """Hybrid torch/mx training (reference example/torch/torch_module.py):
     torch nn.Modules as Custom ops, mx autograd driving torch autograd,

@@ -273,6 +273,22 @@ def test_fused_fit_lockstep_counts_materialize():
     assert opt.num_update == 6
     assert set(opt._index_update_count.values()) == {6}
 
+    # set_lr_mult must NOT tear down the fused state (it only bumps the
+    # lw fingerprint — a hyper-key invalidation would recompile seconds)
+    fs_before = mod._fused_fit
+    mod.fit_step(batch)
+    opt.set_lr_mult({"fullyconnected0_weight": 0.5})
+    mod.fit_step(batch)
+    assert mod._fused_fit is fs_before, "set_lr_mult rebuilt the fused step"
+
+    # force_rebind flushes deferred counts before discarding the state
+    mod._sync_fused_to_exec()
+    n_before = opt.num_update
+    mod.fit_step(batch)  # one pending lockstep count
+    mod.bind(data_shapes=[("data", (16, 6))],
+             label_shapes=[("softmax_label", (16,))], force_rebind=True)
+    assert set(opt._index_update_count.values()) == {n_before + 1}
+
 
 def test_fused_fit_then_score_and_checkpoint(tmp_path):
     """After fused fit, score() and save_checkpoint must see the trained
