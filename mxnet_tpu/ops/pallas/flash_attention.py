@@ -41,13 +41,23 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 BLOCK_Q = 256
-BLOCK_K = 256
+# Round-5 block sweep on v5e (bq x bk over {256,512,1024}x{256,512},
+# forward, causal, D=128): bk=512 wins the FORWARD at every selected
+# shape — 1.33x @S2048, 1.66x @S4096, 1.18x @S8192/GQA, 1.26x in the
+# 16k streaming regime, 1.08x at the S=1024 selection threshold — with
+# identical numerics (bf16 maxdiff 0.016 vs the XLA reference,
+# unchanged). The backward kernels are insensitive to both block sizes
+# (measured flat), so their cost model is untouched. bq=512 adds
+# nothing over bq=256 once bk=512.
+BLOCK_K = 512
 # Selection gate (the cudnn-autotune "must not lose" contract): measured
-# on v5e (examples/transformer/bench_transformer.py micro), the kernel is
-# 2.2x at S=2048 and 5.4x at S=4096 but 0.91x at S=512 — short sequences
-# amortize the kernel's per-block softmax bookkeeping worse than XLA's
-# fused einsum. Gate to sequences where it measurably wins.
-MIN_SEQ = 1024
+# on v5e (examples/transformer/bench_transformer.py micro). With the
+# round-5 bk=512 tiles the kernel wins from S=512 up — 1.45-1.57x at
+# S=512, 2.9-3.4x at S=2048, 4.8-8.5x at S=4096 — and still loses at
+# S=256 (0.78-0.93x: too few tiles to amortize the per-block softmax
+# bookkeeping vs XLA's fused einsum). Gate re-placed accordingly
+# (was 1024 when the 256-wide tiles made S=512 a 0.91x loss).
+MIN_SEQ = 512
 # Longest sequence whose K/V (one side) stays whole in VMEM: 8192 * 128
 # lanes * 2B = 2 MiB per buffer, measured to fit alongside everything
 # else; 16384 exceeds the 16 MiB scoped-VMEM limit (the compile error
@@ -253,7 +263,7 @@ def _fa_forward(q, k, v, causal, scale, interpret, with_lse=False):
     bkv, g, tq, d = q.shape
     tk = k.shape[1]
     block_q = min(BLOCK_Q, tq)
-    block_k = min(BLOCK_K, tk)
+    block_k = _pick_block(tk, BLOCK_K)
     resident = tk <= _RESIDENT_MAX
     kwargs = {}
     out_specs3 = [pl.BlockSpec((1, 1, block_q, d),
@@ -613,7 +623,7 @@ def _fa_backward(q, k, v, o, lse, do, causal, scale, interpret,
     bkv, g, tq, d = q.shape
     tk = k.shape[1]
     block_q = min(BLOCK_Q, tq)
-    block_k = min(BLOCK_K, tk)
+    block_k = _pick_block(tk, BLOCK_K)
     # D_i = rowsum(dO * O): one cheap fused XLA pass. A cotangent on the
     # logsumexp output folds in here: d(lse)/ds = p, so ds gains
     # +g_lse*p, i.e. D := D - g_lse (ring attention's merge
@@ -786,16 +796,35 @@ def _aligned(t, block):
     return t % min(block, t) == 0
 
 
+# Finest K tile the kernels accept: the CONTRACT is divisibility by this,
+# NOT by BLOCK_K — _pick_block falls back from the preferred (faster)
+# 512-wide tile to 256 for lengths like 768/1280/2816, so raising
+# BLOCK_K never narrows which shapes qualify (ring-attention chunks
+# that are odd multiples of 256 keep their flash path).
+_MIN_TILE_K = 256
+
+
+def _pick_block(t, pref):
+    """Largest tile in {pref, pref/2, ..., _MIN_TILE_K} dividing t
+    (t itself when t < _MIN_TILE_K)."""
+    b = min(pref, t)
+    while b > _MIN_TILE_K and t % b:
+        b //= 2
+    return b
+
+
 def kernel_qualifies(tq, tk, d, compiled=True, causal=False):
     """The kernel's CORRECTNESS contract: sequence lengths divide into
     whole blocks (a ragged final block would read padding into the
-    softmax); the compiled path additionally needs a lane-aligned
-    head_dim; causal calls need tq <= tk (with tq > tk the first tk-tq
-    query rows are FULLY masked — the XLA path's finfo.min masking
-    degrades to uniform attention there, while the kernel's l=0 would
-    produce NaN). Shared by flash_attention() and ring_attention's
-    per-shard selection so the two paths cannot drift."""
-    return (_aligned(tq, BLOCK_Q) and _aligned(tk, BLOCK_K)
+    softmax) — K at the finest `_MIN_TILE_K` granularity (the actual
+    tile is picked per shape by `_pick_block`); the compiled path
+    additionally needs a lane-aligned head_dim; causal calls need
+    tq <= tk (with tq > tk the first tk-tq query rows are FULLY masked —
+    the XLA path's finfo.min masking degrades to uniform attention
+    there, while the kernel's l=0 would produce NaN). Shared by
+    flash_attention() and ring_attention's per-shard selection so the
+    two paths cannot drift."""
+    return (_aligned(tq, BLOCK_Q) and _aligned(tk, _MIN_TILE_K)
             and (not causal or tq <= tk)
             and (not compiled or d % 128 == 0))
 

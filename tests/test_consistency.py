@@ -507,12 +507,27 @@ def test_pallas_flash_streaming_regime_matches_xla(monkeypatch):
     monkeypatch.setattr(fa, "SUPER_TARGET", 512)
     rng = np.random.RandomState(13)
     B, D = 1, 8
-    # (h, hkv, tq, tk, causal): all > 256 shapes take the streaming path
+    # (h, hkv, tq, tk, causal): all > 256 shapes take the streaming path.
+    # The sweep runs at BOTH tile widths: bk=256 keeps inner=2 tiles per
+    # superblock (the in-superblock fori_loop's causal partial bound),
+    # which the default bk=512 collapses to inner=1 at these CI sizes;
+    # bk=512 covers the production tile and _pick_block's 512->256
+    # fallback on the odd-multiple tk=768 case.
     cases = ((2, 2, 1024, 1024, True),    # 2 supersteps, causal skip
              (2, 2, 1024, 1024, False),
              (4, 2, 512, 1024, True),     # GQA + offset + streaming
              (4, 1, 512, 1024, True),     # MQA: whole-group accumulation
-             (2, 2, 512, 512, True))      # single superstep boundary
+             (2, 2, 512, 512, True),      # single superstep boundary
+             (2, 2, 512, 768, True))      # tk an odd multiple of 256
+    for bk in (256, fa.BLOCK_K):
+        monkeypatch.setattr(fa, "BLOCK_K", bk)
+        _run_streaming_cases(fa, rng, B, D, cases)
+
+
+def _run_streaming_cases(fa, rng, B, D, cases):
+    from mxnet_tpu.ops.attention import _grouped_attention
+    from mxnet_tpu.ops.attention import dot_product_attention
+
     for h, hkv, tq, tk, causal in cases:
         q = jnp.asarray(rng.randn(B, h, tq, D).astype(np.float32))
         k = jnp.asarray(rng.randn(B, hkv, tk, D).astype(np.float32))
