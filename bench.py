@@ -30,8 +30,10 @@ Two workloads, both through the same fused-step methodology:
 
 The FINAL printed line (the driver's record) carries the transformer-LM
 headline with the ResNet record embedded alongside ("alongside" per the
-round-4 review); each workload's full record is also printed on its own
-line. vs_baseline = MFU / 0.45 (the BASELINE.md north-star target) when
+round-4 review); the ResNet full record is also printed on its own line.
+Each metric appears on exactly ONE well-formed line — the LM record is
+never printed both bare and embedded.
+vs_baseline = MFU / 0.45 (the BASELINE.md north-star target) when
 MFU is computable, else img_per_sec / 181.53 (P100 reference row).
 BENCH_MODEL=resnet|transformer restricts the run (the restricted
 workload's record is then the last line).
@@ -423,15 +425,14 @@ def main():
     if which == "transformer":
         print(json.dumps(run_transformer_config()))
         return
-    # default: BOTH workloads; each full record on its own line, then the
-    # driver-facing final line = the transformer-LM headline (the
-    # compute-bound, north-star-class number on this chip) with the
-    # ResNet record embedded alongside
+    # default: BOTH workloads — ONE line per metric. The ResNet record gets
+    # its own line; the driver-facing final line is the transformer-LM
+    # headline (the compute-bound, north-star-class number on this chip)
+    # with the ResNet record embedded alongside. The LM record is NOT also
+    # printed bare: that duplicated the metric in the captured tail.
     resnet = run_config(BATCH)
     print(json.dumps(resnet), flush=True)
-    lm = run_transformer_config()
-    print(json.dumps(lm), flush=True)
-    final = dict(lm)
+    final = dict(run_transformer_config())
     final["resnet50"] = {k: resnet[k] for k in
                          ("metric", "value", "unit", "vs_baseline",
                           "img_per_sec", "step_time_ms") if k in resnet}

@@ -26,11 +26,18 @@ else
     python -m pytest tests/ -q
 fi
 
-echo "== stage 3: multi-chip sharding dry-run (8 virtual devices) =="
+echo "== stage 3: parallel tests (8-device CPU simulation, -m parallel) =="
+# Dedicated pass over the multi-device tests (ZeRO-1 sharded update,
+# sharding round-trips, kvstore sharded push/pull). conftest.py forces the
+# 8-virtual-device CPU mesh; the explicit env makes the stage independently
+# reproducible: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+python -m pytest tests/ -q -m parallel
+
+echo "== stage 4: multi-chip sharding dry-run (8 virtual devices) =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== stage 4: import hygiene =="
+echo "== stage 5: import hygiene =="
 python - <<'EOF'
 import os
 os.environ.setdefault("JAX_PLATFORMS", "cpu")

@@ -61,9 +61,8 @@ class Executor:
         test_dtype.py) — bf16 needs no loss scaling, unlike fp16.
         Default from MXNET_COMPUTE_DTYPE env var."""
         self._symbol = symbol
-        import os as _os
         if compute_dtype is None:
-            compute_dtype = _os.environ.get("MXNET_COMPUTE_DTYPE") or None
+            compute_dtype = os.environ.get("MXNET_COMPUTE_DTYPE") or None
         self._compute_dtype = (jnp.dtype(compute_dtype)
                                if compute_dtype not in (None, "", "float32")
                                else None)
@@ -197,7 +196,7 @@ class Executor:
             self._grad_names = grad_names
         return self._fwd_bwd_fn
 
-    def make_train_step(self, update_fn, chain=1):
+    def make_train_step(self, update_fn, chain=1, mesh=None, shard_axis="data"):
         """Build ONE jitted computation for a whole training step:
         forward + backward + optimizer update, with parameter and
         optimizer-state buffers donated so XLA updates them in place.
@@ -237,14 +236,35 @@ class Executor:
         copies). The first call relayouts the caller's arrays once;
         returned params stay in the chosen layouts thereafter.
         MXNET_STEP_AUTO_LAYOUT=0 disables.
+
+        ``mesh``: a jax Mesh with a data-parallel axis ``shard_axis``.
+        When its size is > 1 (and MXNET_SHARDED_UPDATE != 0) the update
+        phase runs ZeRO-1 sharded (Xu et al., PAPERS.md): the f32 master
+        weights and optimizer state live 1/N-sharded across the data
+        axis, gradients are reduce-scattered onto the shards, each
+        replica updates only its shard, and the new weights are
+        all-gathered for the next forward — all expressed as sharding
+        constraints inside the ONE donated program, so XLA's SPMD
+        partitioner places (and overlaps) the collectives. The first
+        call commits params/states to the sharded layout; returned
+        values stay sharded, so thread them back in as usual.
         """
         eval_fn = self._eval_fn
         grad_names = list(self._grad_names_list())
         data_names = [n for n in self._arg_names if n not in set(grad_names)]
         cd = self._compute_dtype
         chain = max(1, int(chain))
+        from .parallel import collectives as _coll
+        sharded = _coll.zero1_enabled(mesh, shard_axis)
 
         def one_step(params, states, aux_values, rng, data_values, *extra):
+            # ZeRO-1: params arrive 1/N-sharded; gather them replicated
+            # for forward/backward. vjp's transpose of the gather is a
+            # reduction back to the shard layout, which — fused with the
+            # data-parallel gradient psum — is exactly reduce_scatter.
+            full = (_coll.replicate_constrain(params, mesh)
+                    if sharded else params)
+
             def f(p):
                 av = dict(data_values)
                 av.update(p)
@@ -258,10 +278,17 @@ class Executor:
                     aux_up = _cast_floats(aux_up, jnp.float32, src=cd)
                 return outs, aux_up
 
-            (outs, aux_up), vjp = jax.vjp(f, params)
+            (outs, aux_up), vjp = jax.vjp(f, full)
             (grads,) = vjp(([jnp.ones_like(o) for o in outs],
                             {k: jnp.zeros_like(v) for k, v in aux_up.items()}))
+            if sharded:
+                grads = _coll.zero1_constrain(grads, mesh, shard_axis)
             new_params, new_states = update_fn(params, grads, states, *extra)
+            if sharded:
+                new_params = _coll.zero1_constrain(new_params, mesh,
+                                                   shard_axis)
+                new_states = _coll.zero1_constrain(new_states, mesh,
+                                                   shard_axis)
             return outs, new_params, new_states, aux_up
 
         if chain == 1:
@@ -289,7 +316,7 @@ class Executor:
                     and os.environ.get(
                         "MXNET_STEP_AUTO_LAYOUT", "1") != "0")
         jitted = None if use_auto else jax.jit(step, donate_argnums=(0, 1))
-        aot = {}  # compiled, in_formats (built on first call)
+        aot = {}  # compiled, in_formats, placed (built on first call)
 
         def run(params, states, data_values, *extra):
             rng = self._next_rng()
@@ -299,20 +326,35 @@ class Executor:
             for n in data_names:
                 if n not in dv and n in self.arg_dict:
                     dv[n] = self.arg_dict[n]._data
+            if sharded and not aot.get("placed"):
+                # first bind: materialize master weights + optimizer state
+                # directly in the 1/N ZeRO-1 layout (never
+                # replicated-then-sliced); returned values keep it, so
+                # this runs once
+                params = _coll.zero1_place(params, mesh, shard_axis)
+                states = _coll.zero1_place(states, mesh, shard_axis)
+                aot["placed"] = True
             if use_auto:
-                if not aot:
+                if not aot.get("informats"):
                     from jax.experimental.layout import Format, Layout
-
-                    auto = Format(Layout.AUTO)
 
                     def spec(tree):
                         # AUTO only for >=2D leaves (conv/fc weights —
                         # where the per-step layout copies live); small
                         # vectors keep the default layout (XLA's chosen
                         # exotic vector tilings break the tunneled
-                        # backend's donation path)
-                        return jax.tree_util.tree_map(
-                            lambda a: auto if a.ndim >= 2 else None, tree)
+                        # backend's donation path). Under the ZeRO-1
+                        # sharded update the Format also pins each
+                        # leaf's NamedSharding so the learned layouts
+                        # apply to the 1/N shards.
+                        def one(a):
+                            if sharded:
+                                sh = _coll.zero1_sharding(
+                                    mesh, a.shape, shard_axis)
+                                return (Format(Layout.AUTO, sh)
+                                        if a.ndim >= 2 else sh)
+                            return Format(Layout.AUTO) if a.ndim >= 2 else None
+                        return jax.tree_util.tree_map(one, tree)
 
                     nextra = (None,) * len(extra)
                     pspec, sspec = spec(params), spec(states)

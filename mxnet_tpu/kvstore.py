@@ -86,11 +86,23 @@ class KVStore:
                 if k not in self._store:
                     raise MXNetError("push to uninitialized key %r" % (k,))
                 stored = self._store[k]
-                # adopt the gradient's (mesh) sharding so the fused update
-                # runs where the executor's arrays live — the analogue of
-                # the reference's merge-buffer placement (comm.h:333-361)
-                if stored._data.sharding != merged._data.sharding:
-                    stored._data = jax.device_put(stored._data, merged._data.sharding)
+                ssh = stored._data.sharding
+                gsh = merged._data.sharding
+                if ssh != gsh:
+                    if (ssh.device_set == gsh.device_set
+                            and not ssh.is_fully_replicated):
+                        # the stored master value is deliberately sharded
+                        # over the same mesh (ZeRO-1 weight-update layout):
+                        # bring the merged gradient TO the shards (the
+                        # resharding device_put IS the reduce_scatter leg)
+                        # instead of destroying the stored layout
+                        merged = NDArray(jax.device_put(merged._data, ssh))
+                    else:
+                        # adopt the gradient's (mesh) sharding so the fused
+                        # update runs where the executor's arrays live — the
+                        # analogue of the reference's merge-buffer placement
+                        # (comm.h:333-361)
+                        stored._data = jax.device_put(stored._data, gsh)
                 self._updater(_updater_key(k), merged, stored)
             else:
                 self._store[k] = merged
@@ -105,7 +117,10 @@ class KVStore:
             src = self._store[k]
             for o in outs:
                 # broadcast into the target's own sharding (replicated over
-                # the mesh for params) — Comm::Broadcast (comm.h:268)
+                # the mesh for params) — Comm::Broadcast (comm.h:268). When
+                # the stored value is ZeRO-1 sharded (dist_sync with the
+                # sharded update) this device_put is the weight all-gather:
+                # the puller always receives full values, never a bare shard
                 if o._data.sharding != src._data.sharding:
                     o._data = jax.device_put(src._data, o._data.sharding)
                 else:

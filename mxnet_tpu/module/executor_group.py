@@ -95,6 +95,12 @@ class DataParallelExecutorGroup:
         self.slices = [slice(i * step, (i + 1) * step if i < k - 1 else self.batch_size)
                        for i in range(k)]
 
+    @property
+    def data_parallel_size(self):
+        """Replica count along the data mesh axis (1 when single-device) —
+        the N of the ZeRO-1 sharded update's 1/N state shards."""
+        return 1 if self.mesh is None else int(dict(self.mesh.shape)["data"])
+
     # ------------------------------------------------------------------
     def _shape_of(self, desc):
         return tuple(desc.shape) if hasattr(desc, "shape") else tuple(desc[1])

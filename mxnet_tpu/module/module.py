@@ -26,6 +26,7 @@ from ..model import (
     _update_params, _update_params_on_kvstore, load_checkpoint,
     save_checkpoint,
 )
+from ..parallel import collectives as _collectives
 from .base_module import BaseModule, _check_input_names
 from .executor_group import DataParallelExecutorGroup
 
@@ -465,8 +466,15 @@ class Module(BaseModule):
         if fp is None or fs.get("lw_fp") != fp or "lw" not in fs:
             lw = np.array([opt_.effective_lr_wd(idx_of[n])
                            for n in fs["names"]], np.float32)
-            # lr/wd arrays cached across steps (constant-lr: no re-upload)
-            _, _, fs["lw"] = cached_lr_wd_arrays(fs.get("lw"), lw)
+            # lr/wd arrays cached across steps (constant-lr: no re-upload);
+            # committed replicated over the data mesh under ZeRO-1 so the
+            # sharded step isn't fed single-device arrays
+            lw_sh = None
+            if fs.get("z1"):
+                from jax.sharding import NamedSharding, PartitionSpec
+                lw_sh = NamedSharding(fs["mesh"], PartitionSpec())
+            _, _, fs["lw"] = cached_lr_wd_arrays(fs.get("lw"), lw,
+                                                 sharding=lw_sh)
             fs["lw_fp"] = fp
         lr_arr, wd_arr = fs["lw"][1], fs["lw"][2]
         # place the batch with the group's device/sharding logic; the step
@@ -512,33 +520,57 @@ class Module(BaseModule):
                                           lr_arr[pos], wd_arr[pos])
             return new_p, new_s
 
-        step = exec_.make_train_step(update_fn)
+        # ZeRO-1 sharded update (Xu et al.): over the exec group's data
+        # mesh, master weights + optimizer state live 1/N-sharded and the
+        # step reduce-scatters grads / all-gathers updated weights inside
+        # the one donated program (Executor.make_train_step mesh path)
+        mesh = getattr(self._exec_group, "mesh", None)
+        z1 = _collectives.zero1_enabled(mesh)
+        step = exec_.make_train_step(update_fn, mesh=mesh)
         # device-side copies: the step donates these, and donation must not
         # delete buffers aliased by exec arg_dict / user-held NDArrays
-        params = {n: jnp.array(exec_.arg_dict[n]._data, copy=True)
-                  for n in names}
-        states = {}
+        params, states = self._fused_snapshot(exec_, names, idx_of, mesh, z1)
         hyper_key = self._optimizer._hyperparam_key()
-        for n in names:
-            i = idx_of[n]
-            self._updater.ensure_state(i, exec_.arg_dict[n], key=hyper_key)
-            states[n] = state_leaves(self._updater.states[i], copy=True)
         self._fused_fit = {"step": step, "params": params, "states": states,
                            "names": names, "idx_of": idx_of,
-                           "hyper": hyper_key}
+                           "hyper": hyper_key, "mesh": mesh, "z1": z1}
         return self._fused_fit
+
+    def _fused_snapshot(self, exec_, names, idx_of, mesh, z1):
+        """Donation-safe device copies of params + optimizer state for the
+        fused step. Under the ZeRO-1 path params are committed straight to
+        their 1/N sharded layout and NEW optimizer state is created from the
+        sharded weight (born sharded, never replicated-then-sliced);
+        pre-existing state copies are resharded once here."""
+        hyper_key = self._optimizer._hyperparam_key()
+        if z1:
+            params = _collectives.zero1_place(
+                {n: exec_.arg_dict[n]._data for n in names}, mesh)
+        else:
+            params = {n: jnp.array(exec_.arg_dict[n]._data, copy=True)
+                      for n in names}
+        states = {}
+        for n in names:
+            i = idx_of[n]
+            if z1:
+                self._updater.ensure_state_sharded(i, exec_.arg_dict[n],
+                                                   mesh, key=hyper_key)
+                states[n] = _collectives.zero1_place(
+                    state_leaves(self._updater.states[i]), mesh)
+            else:
+                self._updater.ensure_state(i, exec_.arg_dict[n],
+                                           key=hyper_key)
+                states[n] = state_leaves(self._updater.states[i], copy=True)
+        return params, states
 
     def _refresh_fused_snapshot(self, fs):
         """Re-copy params/optimizer state from exec/updater buffers into the
         fused snapshot (after set_params / a manual update), reusing the
-        already-compiled step program."""
+        already-compiled step program. Under ZeRO-1 the refreshed copies go
+        straight back to the sharded layout the compiled step expects."""
         exec_ = self._exec_group._exec
-        hyper_key = self._optimizer._hyperparam_key()
-        for n in fs["names"]:
-            fs["params"][n] = jnp.array(exec_.arg_dict[n]._data, copy=True)
-            i = fs["idx_of"][n]
-            self._updater.ensure_state(i, exec_.arg_dict[n], key=hyper_key)
-            fs["states"][n] = state_leaves(self._updater.states[i], copy=True)
+        fs["params"], fs["states"] = self._fused_snapshot(
+            exec_, fs["names"], fs["idx_of"], fs["mesh"], fs["z1"])
         self._fused_refresh = False
         self._fused_dirty = False
 
@@ -565,9 +597,14 @@ class Module(BaseModule):
             return
         exec_ = self._exec_group._exec
         for n in fs["names"]:
-            exec_.arg_dict[n]._data = fs["params"][n]
-            write_state_leaves(self._updater.states.get(fs["idx_of"][n]),
-                               fs["states"][n])
+            p, s = fs["params"][n], fs["states"][n]
+            if fs.get("z1"):
+                # exec/updater storage is replicated: all-gather the 1/N
+                # master shards once on the way out (checkpoint/get_params)
+                p = _collectives.replicate_place(p, fs["mesh"])
+                s = _collectives.replicate_place(s, fs["mesh"])
+            exec_.arg_dict[n]._data = p
+            write_state_leaves(self._updater.states.get(fs["idx_of"][n]), s)
         self._fused_dirty = False
 
     def install_monitor(self, mon):

@@ -34,14 +34,21 @@ def register(klass):
 
 
 
-def cached_lr_wd_arrays(cache, lw):
+def cached_lr_wd_arrays(cache, lw, sharding=None):
     """(lr_arr, wd_arr, new_cache): re-upload the stacked lr/wd arrays only
     when the host-side values changed — shared by Updater.update_all and
-    Module's fused fit step."""
+    Module's fused fit step. `sharding` (e.g. replicated over the data
+    mesh for the ZeRO-1 sharded update) commits the uploads to the mesh
+    so the fused step isn't fed single-device arrays."""
+    import jax
     import jax.numpy as jnp
 
     if cache is None or not np.array_equal(cache[0], lw):
-        cache = (lw, jnp.asarray(lw[:, 0]), jnp.asarray(lw[:, 1]))
+        lr_arr, wd_arr = jnp.asarray(lw[:, 0]), jnp.asarray(lw[:, 1])
+        if sharding is not None:
+            lr_arr = jax.device_put(lr_arr, sharding)
+            wd_arr = jax.device_put(wd_arr, sharding)
+        cache = (lw, lr_arr, wd_arr)
     return cache[1], cache[2], cache
 
 
@@ -656,6 +663,23 @@ class Updater:
                 self.states[index] = fresh
         self._state_keys[index] = key
         return self.states[index]
+
+    def ensure_state_sharded(self, index, weight, mesh, axis_name="data",
+                             key=None):
+        """ensure_state with the weight viewed in its ZeRO-1 layout, so NEW
+        state buffers are BORN 1/N-sharded across the data axis
+        (_zeros_like_state inherits the weight's sharding) instead of
+        allocated replicated and resharded later. Existing states are
+        returned untouched — callers reshard those copies themselves."""
+        import jax
+
+        from .parallel.collectives import zero1_sharding
+
+        w = weight._data
+        sh = zero1_sharding(mesh, w.shape, axis_name)
+        if w.sharding != sh:
+            w = jax.device_put(w, sh)
+        return self.ensure_state(index, NDArray(w), key=key)
 
     def __call__(self, index, grad, weight):
         self.ensure_state(index, weight)

@@ -38,7 +38,8 @@ EXPERT_GROUP: Tuple[str, ...] = ("data", "expert", "seq")
 
 def group_size(group: Sequence[str] = EXPERT_GROUP) -> int:
     """Size of the expert group inside a shard_map body."""
-    return int(jax.lax.axis_size(tuple(group)))
+    from .collectives import axis_size
+    return axis_size(tuple(group))
 
 
 def switch_moe_local(x, wg, w1, w2, *, group: Sequence[str] = EXPERT_GROUP,

@@ -48,7 +48,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from .collectives import axis_size, shard_map  # version-tolerant wrappers
 
 
 def _fwd_scan(stage_fn, stage_params, x_mb, axis, with_aux):
@@ -56,7 +56,7 @@ def _fwd_scan(stage_fn, stage_params, x_mb, axis, with_aux):
     is populated ONLY on the last pipe rank (zeros elsewhere) and
     aux_sum_local is this rank's masked aux total (0.0 when not
     with_aux)."""
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     idx = jax.lax.axis_index(axis)
     n_micro = x_mb.shape[0]
     steps = n_micro + n - 1
@@ -86,8 +86,12 @@ def _fwd_scan(stage_fn, stage_params, x_mb, axis, with_aux):
 
     h0 = jnp.zeros_like(x_mb[0])
     out0 = jnp.zeros_like(x_mb)
+    # (1,)-shaped aux carry, NOT a scalar: this jax's shard_map autodiff
+    # can't emit rank-0 device-varying residuals (its own error text says
+    # to "add at least one (singleton) axis"), and a scalar carry here
+    # surfaces as exactly such a residual under jax.grad
     (_, out, aux_sum), _ = jax.lax.scan(
-        tick, (h0, out0, jnp.zeros((), jnp.float32)), jnp.arange(steps))
+        tick, (h0, out0, jnp.zeros((1,), jnp.float32)), jnp.arange(steps))
     return out, aux_sum
 
 
@@ -96,7 +100,7 @@ def broadcast_from_last(out, axis):
     output convention; callers that reduce to a scalar on the last rank
     skip this and psum the scalar instead)."""
     idx = jax.lax.axis_index(axis)
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     return jax.lax.psum(
         jnp.where(idx == n - 1, out, jnp.zeros_like(out)), axis)
 
@@ -118,7 +122,7 @@ def spmd_pipeline_local(stage_fn, stage_params, x_mb, *, axis="pipe",
     if broadcast_out:
         out = broadcast_from_last(out, axis)
     if with_aux:
-        return out, jax.lax.psum(aux_sum, axis)
+        return out, jax.lax.psum(aux_sum, axis)[0]
     return out
 
 
@@ -130,14 +134,14 @@ def spmd_pipeline_local_1f1b(stage_fn, stage_params, x_mb, axis="pipe",
     O(n_stages) instead of O(n_micro) — see the module docstring.
     Always returns (out, aux_sum); aux_sum is 0.0 when not with_aux."""
     out, aux = _fwd_scan(stage_fn, stage_params, x_mb, axis, with_aux)
-    return out, jax.lax.psum(aux, axis)
+    return out, jax.lax.psum(aux, axis)[0]
 
 
 def _1f1b_fwd(stage_fn, stage_params, x_mb, axis, with_aux):
     out, aux = _fwd_scan(stage_fn, stage_params, x_mb, axis, with_aux)
     # residuals: pipeline INPUTS only — every stage activation is
     # recomputed in the backward's fwd sub-steps
-    return ((out, jax.lax.psum(aux, axis)), (stage_params, x_mb))
+    return ((out, jax.lax.psum(aux, axis)[0]), (stage_params, x_mb))
 
 
 def _1f1b_bwd(stage_fn, axis, with_aux, res, cots):
@@ -148,7 +152,7 @@ def _1f1b_bwd(stage_fn, axis, with_aux, res, cots):
     # cotangents (shard_map delivers a replicated output's cotangent
     # split across ranks)
     daux = jax.lax.psum(daux, axis)
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     idx = jax.lax.axis_index(axis)
     m = x_mb.shape[0]
     ring_depth = 2 * n - 1           # max in-flight microbatches per stage

@@ -19,7 +19,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from .collectives import axis_size, shard_map  # version-tolerant wrappers
 
 _NEG = float(jnp.finfo(jnp.float32).min)
 
@@ -93,7 +93,7 @@ def _block_attn_flash(q, k, v, mode, interpret=False):
 
 def _ring_attn_local(q, k, v, *, axis_name, causal, chunk, use_flash=False):
     """Body run per-device inside shard_map. q/k/v: local (B,H,T/n,D)."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     b, h, t, d = q.shape
 

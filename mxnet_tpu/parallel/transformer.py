@@ -28,8 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
-
+from .collectives import shard_map, zero1_update_local
 from .moe import EXPERT_GROUP, scale_expert_grads, switch_moe_local
 from .pipeline import spmd_pipeline_local, spmd_pipeline_local_1f1b
 from .ring_attention import _ring_attn_local
@@ -175,14 +174,27 @@ def _stage_fn(stage_params, h, cfg: TransformerConfig):
 
 
 def make_train_step(mesh: Mesh, cfg: TransformerConfig, n_micro: int = None,
-                    lr: float = 1e-2):
+                    lr: float = 1e-2, sharded_update: bool = None):
     """Returns (train_step, sharded_init) where
     train_step(params, tokens, targets) -> (loss, new_params) is jitted
     over the full 4-axis mesh with SGD applied in-graph — the
-    'update_on_kvstore inside the step' design (SURVEY §7 table)."""
+    'update_on_kvstore inside the step' design (SURVEY §7 table).
+
+    sharded_update: manual ZeRO-1 weight update over the "data" axis
+    (collectives.zero1_update_local): dense grads are reduce-scattered
+    instead of pmean'd, each data replica updates its 1/N weight shard,
+    and the new weights are all-gathered — the explicit-collective twin
+    of Executor.make_train_step's GSPMD path. Default: on when the data
+    axis is >1 and MXNET_SHARDED_UPDATE != 0. Expert-sharded weights
+    already hold distinct experts per rank and keep their local update."""
     n_pipe = mesh.shape["pipe"]
     if n_micro is None:
         n_micro = max(2, n_pipe)
+    if sharded_update is None:
+        import os
+        sharded_update = (int(mesh.shape["data"]) > 1
+                          and os.environ.get("MXNET_SHARDED_UPDATE",
+                                             "1") != "0")
     specs = param_specs(cfg)
 
     def local_fwd(params, tokens, targets):
@@ -254,15 +266,33 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, n_micro: int = None,
         # weights hold DIFFERENT experts per rank: AD already summed the
         # cross-rank contributions through the all_to_all transpose, so
         # they take a 1/G scale instead of a pmean (moe.scale_expert_grads).
-        grads = scale_expert_grads(grads, EXPERT_KEYS, group=dp_axes)
+        # Under the sharded update the "data" leg of the dense pmean is
+        # DEFERRED: zero1_update_local folds it into its reduce_scatter.
+        dense_axes = ("expert", "seq") if sharded_update else None
+        grads = scale_expert_grads(grads, EXPERT_KEYS, group=dp_axes,
+                                   dense_axes=dense_axes)
         # embed's cotangent only reaches pipe rank 0 (the pipeline ingests
         # x there); unembed/lnf cotangents only reach the LAST pipe rank
         # (the head + loss are rank-masked there — no activation-buffer
         # broadcast). psum over "pipe" makes each whole/replicated.
         for k in ("embed", "unembed", "lnf"):
             grads[k] = jax.lax.psum(grads[k], "pipe")
-        new_params = jax.tree_util.tree_map(
-            lambda p, g: (p - lr * g).astype(p.dtype), params, grads)
+
+        def sgd(w, g):
+            return (w - lr * g).astype(w.dtype)
+
+        if sharded_update:
+            new_params = {}
+            for k in params:
+                if k in EXPERT_KEYS:
+                    # distinct experts per rank: grads are already summed
+                    # + 1/G scaled; the update stays local
+                    new_params[k] = sgd(params[k], grads[k])
+                else:
+                    new_params[k] = zero1_update_local(
+                        params[k], grads[k], sgd, axis_name="data")
+        else:
+            new_params = jax.tree_util.tree_map(sgd, params, grads)
         loss = jax.lax.pmean(loss, dp_axes)
         return loss, new_params
 

@@ -51,3 +51,7 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: exhaustive registry sweeps (nightly tier; "
         "run with ci/run_tests.sh --full)")
+    config.addinivalue_line(
+        "markers", "parallel: multi-device tests that need the simulated "
+        "8-device CPU mesh (this conftest forces it; ci/run_tests.sh runs "
+        "them both inside the quick tier and as a dedicated stage)")

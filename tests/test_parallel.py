@@ -73,7 +73,7 @@ def _pipeline_grad_fn(mesh, n_stages, dim, n_micro, schedule,
     tanh(h @ w1) @ w2 (wide hidden makes per-tick activations big for
     the memory test) + optional data-dependent aux channel."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from mxnet_tpu.parallel.collectives import axis_size, shard_map
 
     hidden = hidden or dim
 
@@ -86,7 +86,7 @@ def _pipeline_grad_fn(mesh, n_stages, dim, n_micro, schedule,
 
     def body(p, xm):
         sp = jax.tree_util.tree_map(lambda a: a[0], p)
-        n = jax.lax.axis_size("pipe")
+        n = axis_size("pipe")
         idx = jax.lax.axis_index("pipe")
         if schedule == "1f1b":
             out, aux = pipeline.spmd_pipeline_local_1f1b(
@@ -212,7 +212,7 @@ def test_switch_moe_local_matches_dense_routing():
     """Expert-parallel Switch FFN over a 2-wide (data,expert,seq) group
     == per-token top-1 expert FFN when capacity is ample (no drops)."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from mxnet_tpu.parallel.collectives import axis_size, shard_map
     from mxnet_tpu.parallel import moe
 
     mesh = make_mesh(MeshConfig(data=2, seq=2, pipe=1, model=2))
@@ -301,7 +301,7 @@ def test_switch_moe_overflow_drops_match_dense_reference():
     dense per-token reference that zeroes exactly the dropped tokens —
     the token-drop path is load-bearing, not an untested corner."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from mxnet_tpu.parallel.collectives import axis_size, shard_map
     from mxnet_tpu.parallel import moe
 
     mesh = make_mesh(MeshConfig(data=2, seq=2, pipe=1, model=2))
@@ -363,7 +363,7 @@ def test_moe_aux_loss_keeps_routing_balanced():
     make_train_step's objective (capacity bounds do NOT enforce
     balance; they just drop the overflow)."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from mxnet_tpu.parallel.collectives import axis_size, shard_map
     from mxnet_tpu.parallel import moe
 
     mesh = make_mesh(MeshConfig(data=2, seq=2, pipe=1, model=2))

@@ -1,0 +1,324 @@
+"""ZeRO-1 cross-replica sharded optimizer update (Xu et al., PAPERS.md).
+
+Runs on the suite's simulated 8-device CPU mesh (conftest.py forces
+XLA_FLAGS=--xla_force_host_platform_device_count=8). Covers:
+
+- numerical equivalence of the sharded update vs the replicated path
+  (SGD-momentum and Adam through Module.fit_step; a hand-rolled momentum
+  rule through Executor.make_train_step with grad_req="add" bindings);
+- uneven trees: leaves whose shapes don't divide the data-axis size stay
+  replicated (per-leaf assignment) and round-trip EXACTLY;
+- per-replica optimizer-state bytes ~1/N;
+- the donation contract (inputs consumed — the step stays ONE donated
+  XLA program);
+- kvstore push/pull preserving deliberately sharded stored values.
+
+Equivalence tolerance: the sharded update computes the same f32 math on
+1/N shards; XLA CPU keeps all-reduce+slice (the reduce-scatter fusion is
+the TPU SPMD partitioner's), so sums reassociate and results match to
+f32 round-off, not bit-exactly (docs/parallelism.md).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.executor import Executor
+from mxnet_tpu.initializer import Uniform
+from mxnet_tpu.io import DataBatch
+from mxnet_tpu.ndarray import NDArray
+from mxnet_tpu.parallel import collectives as coll
+
+pytestmark = pytest.mark.parallel
+
+N_DEV = 8
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:N_DEV]), ("data",))
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _batch(rng, batch=16, feat=8, classes=4):
+    x = rng.uniform(-1, 1, (batch, feat)).astype(np.float32)
+    y = rng.randint(0, classes, (batch,)).astype(np.float32)
+    return DataBatch(data=[mx.nd.array(x)], label=[mx.nd.array(y)])
+
+
+def _train_module(monkeypatch, sharded, opt="sgd", opt_params=None, steps=4):
+    monkeypatch.setenv("MXNET_SHARDED_UPDATE", "1" if sharded else "0")
+    ctxs = [mx.Context("cpu", i) for i in range(N_DEV)]
+    mod = mx.mod.Module(_mlp(), context=ctxs)
+    mx.random.seed(7)
+    mod.bind(data_shapes=[("data", (16, 8))],
+             label_shapes=[("softmax_label", (16,))])
+    mod.init_params(Uniform(0.1))
+    mod.init_optimizer(kvstore=None, optimizer=opt,
+                       optimizer_params=opt_params
+                       or {"learning_rate": 0.1, "momentum": 0.9})
+    rng = np.random.RandomState(3)
+    b = _batch(rng)
+    for _ in range(steps):
+        mod.fit_step(b)
+    return mod
+
+
+@pytest.mark.parametrize("opt,opt_params", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+])
+def test_module_sharded_matches_replicated(monkeypatch, opt, opt_params):
+    """Module.fit_step with the ZeRO-1 update == the replicated update to
+    f32 round-off, for SGD-momentum and Adam."""
+    m_sh = _train_module(monkeypatch, True, opt, opt_params)
+    assert m_sh._fused_fit["z1"] is True
+    m_re = _train_module(monkeypatch, False, opt, opt_params)
+    assert m_re._fused_fit["z1"] is False
+    a_sh, _ = m_sh.get_params()
+    a_re, _ = m_re.get_params()
+    for k in a_re:
+        np.testing.assert_allclose(a_sh[k].asnumpy(), a_re[k].asnumpy(),
+                                   rtol=2e-5, atol=1e-6, err_msg=k)
+
+
+def test_module_state_born_sharded_and_bytes_scale(monkeypatch):
+    """Master weights + optimizer state carry the 1/N NamedSharding from
+    first bind, and per-replica state bytes shrink accordingly."""
+    m_sh = _train_module(monkeypatch, True)
+    fs = m_sh._fused_fit
+    mesh = fs["mesh"]
+    for n, p in fs["params"].items():
+        want = coll.zero1_sharding(mesh, p.shape)
+        assert p.sharding == want, (n, p.sharding)
+    sh_bytes = coll.per_device_bytes(fs["states"])
+    re_bytes = coll.per_device_bytes(
+        _train_module(monkeypatch, False)._fused_fit["states"])
+    # fc1 (16x8 + 16) shards fully; fc2_weight on dim 1; only fc2_bias (4,)
+    # stays replicated -> well under half of the replicated footprint
+    assert sh_bytes < re_bytes / 2, (sh_bytes, re_bytes)
+
+
+def test_executor_sharded_matches_replicated_grad_req_add():
+    """Executor.make_train_step equivalence with mixed write/add grad_req
+    bindings — the direct-executor surface of the sharded update."""
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (16, 8)).astype(np.float32)
+    y = rng.randint(0, 4, (16,)).astype(np.float32)
+    w_init = {
+        "fc1_weight": rng.uniform(-0.1, 0.1, (16, 8)).astype(np.float32),
+        "fc1_bias": np.zeros(16, np.float32),
+        "fc2_weight": rng.uniform(-0.1, 0.1, (4, 16)).astype(np.float32),
+        "fc2_bias": np.zeros(4, np.float32),
+    }
+    grad_req = {"fc1_weight": "add", "fc1_bias": "add",
+                "fc2_weight": "write", "fc2_bias": "write",
+                "data": "null", "softmax_label": "null"}
+
+    def momentum_rule(w, g, s, lr=0.1, mom=0.9):
+        s2 = mom * s - lr * g
+        return w + s2, s2
+
+    def update_fn(params, grads, states):
+        new_p, new_s = {}, {}
+        for k in params:
+            new_p[k], new_s[k] = momentum_rule(params[k], grads[k],
+                                               states[k])
+        return new_p, new_s
+
+    def run(mesh):
+        args = {n: mx.nd.array(v) for n, v in w_init.items()}
+        args["data"] = mx.nd.array(x)
+        args["softmax_label"] = mx.nd.array(y)
+        grads = {n: mx.nd.zeros(v.shape) for n, v in w_init.items()}
+        exe = Executor(_mlp(), mx.cpu(0), args, grads, grad_req)
+        step = exe.make_train_step(update_fn, mesh=mesh)
+        params = {n: jnp.asarray(v) for n, v in w_init.items()}
+        states = {n: jnp.zeros_like(v) for n, v in params.items()}
+        for _ in range(3):
+            _, params, states = step(params, states,
+                                     {"data": x, "softmax_label": y})
+        return params, states
+
+    p_sh, s_sh = run(_mesh())
+    p_re, s_re = run(None)
+    for k in p_re:
+        np.testing.assert_allclose(np.asarray(p_sh[k]), np.asarray(p_re[k]),
+                                   rtol=2e-5, atol=1e-6, err_msg=k)
+        np.testing.assert_allclose(np.asarray(s_sh[k]), np.asarray(s_re[k]),
+                                   rtol=2e-5, atol=1e-6, err_msg=k)
+        # outputs keep the ZeRO-1 layout for the next (donated) step
+        assert p_sh[k].sharding.spec == coll.zero1_partition_spec(
+            p_sh[k].shape, N_DEV)
+
+
+def test_step_donates_inputs():
+    """The train step stays ONE donated XLA program: the params/states
+    passed in are consumed (buffers reused in place, kWriteInplace)."""
+    rng = np.random.RandomState(1)
+    x = rng.uniform(-1, 1, (16, 8)).astype(np.float32)
+    y = rng.randint(0, 4, (16,)).astype(np.float32)
+    args = {"data": mx.nd.array(x), "softmax_label": mx.nd.array(y),
+            "fc1_weight": mx.nd.array(
+                rng.uniform(-0.1, 0.1, (16, 8)).astype(np.float32)),
+            "fc1_bias": mx.nd.zeros((16,)),
+            "fc2_weight": mx.nd.array(
+                rng.uniform(-0.1, 0.1, (4, 16)).astype(np.float32)),
+            "fc2_bias": mx.nd.zeros((4,))}
+    pnames = ["fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"]
+    grads = {n: mx.nd.zeros(args[n].shape) for n in pnames}
+    exe = Executor(_mlp(), mx.cpu(0), args, grads, "write")
+
+    def update_fn(params, grads_, states):
+        return ({k: params[k] - 0.1 * grads_[k] for k in params},
+                {k: states[k] for k in states})
+
+    step = exe.make_train_step(update_fn, mesh=_mesh())
+    params = {n: jnp.asarray(args[n].asnumpy()) for n in pnames}
+    states = {n: jnp.zeros_like(v) for n, v in params.items()}
+    _, p1, s1 = step(params, states, {"data": x, "softmax_label": y})
+    # first call re-places into the sharded layout, then the jit donates
+    _, p2, _ = step(p1, s1, {"data": x, "softmax_label": y})
+    assert all(v.is_deleted() for v in jax.tree_util.tree_leaves(p1))
+    assert not any(v.is_deleted() for v in jax.tree_util.tree_leaves(p2))
+
+
+def test_uneven_leaves_stay_replicated_and_round_trip():
+    """Per-leaf assignment: shapes with no dim divisible by N stay P()
+    and survive place->gather EXACTLY; divisible dims shard."""
+    assert coll.zero1_partition_spec((7,), N_DEV) == P()
+    assert coll.zero1_partition_spec((9, 3), N_DEV) == P()
+    assert coll.zero1_partition_spec((16, 3), N_DEV) == P("data")
+    assert coll.zero1_partition_spec((4,), N_DEV) == P()
+    assert coll.zero1_partition_spec((3, 24), N_DEV) == P(None, "data")
+
+    mesh = _mesh()
+    rng = np.random.RandomState(2)
+    tree = {"a": jnp.asarray(rng.randn(7).astype(np.float32)),
+            "b": jnp.asarray(rng.randn(9, 3).astype(np.float32)),
+            "c": jnp.asarray(rng.randn(16, 3).astype(np.float32))}
+    placed = coll.zero1_place(tree, mesh)
+    assert placed["a"].sharding.spec == P()
+    assert placed["c"].sharding.spec == P("data")
+    back = coll.replicate_place(placed, mesh)
+    for k in tree:
+        assert np.array_equal(np.asarray(back[k]), np.asarray(tree[k])), k
+
+
+def test_uneven_model_sharded_vs_replicated(monkeypatch):
+    """End-to-end equivalence when most leaves DON'T divide the data axis
+    (hidden sizes 7 and 3 on an 8-device mesh)."""
+    def net():
+        data = sym.Variable("data")
+        n = sym.FullyConnected(data, num_hidden=7, name="fc1")
+        n = sym.Activation(n, act_type="relu")
+        n = sym.FullyConnected(n, num_hidden=3, name="fc2")
+        return sym.SoftmaxOutput(n, name="softmax")
+
+    def train(sharded):
+        monkeypatch.setenv("MXNET_SHARDED_UPDATE", "1" if sharded else "0")
+        ctxs = [mx.Context("cpu", i) for i in range(N_DEV)]
+        mod = mx.mod.Module(net(), context=ctxs)
+        mx.random.seed(11)
+        mod.bind(data_shapes=[("data", (16, 9))],
+                 label_shapes=[("softmax_label", (16,))])
+        mod.init_params(Uniform(0.1))
+        mod.init_optimizer(kvstore=None, optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9})
+        rng = np.random.RandomState(5)
+        b = _batch(rng, feat=9, classes=3)
+        for _ in range(3):
+            mod.fit_step(b)
+        return mod
+
+    m_sh = train(True)
+    assert m_sh._fused_fit["z1"] is True
+    # fc1_weight (7,9)/fc1_bias (7,): no divisible dim -> replicated
+    assert m_sh._fused_fit["params"]["fc1_weight"].sharding.spec == P()
+    m_re = train(False)
+    a_sh, _ = m_sh.get_params()
+    a_re, _ = m_re.get_params()
+    for k in a_re:
+        np.testing.assert_allclose(a_sh[k].asnumpy(), a_re[k].asnumpy(),
+                                   rtol=2e-5, atol=1e-6, err_msg=k)
+
+
+def test_zero1_update_local_pads_and_round_trips_exactly():
+    """The manual (shard_map) ZeRO-1 update: padding makes ANY leaf size
+    round-trip bit-exactly through reduce_scatter/all_gather."""
+    mesh = _mesh()
+    w = jnp.asarray(np.arange(7, dtype=np.float32))  # 7 % 8 != 0 -> pad
+    g = jnp.asarray(np.ones(7, np.float32))
+
+    def run(update_fn):
+        f = coll.shard_map(
+            lambda w_, g_: coll.zero1_update_local(w_, g_, update_fn,
+                                                   axis_name="data"),
+            mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False)  # all_gather output IS replicated
+        return np.asarray(jax.jit(f)(w, g))
+
+    # identity update: the round trip must reproduce w EXACTLY
+    assert np.array_equal(run(lambda ws, gs: ws), np.asarray(w))
+    # sgd update: grads are replicated here, so the folded data-mean
+    # (psum of N copies / N) must reproduce plain w - lr*g
+    got = run(lambda ws, gs: ws - 0.5 * gs)
+    np.testing.assert_allclose(got, np.asarray(w - 0.5 * g), rtol=1e-6)
+
+
+def test_kvstore_preserves_sharded_stored_values():
+    """dist_sync semantics: a deliberately ZeRO-sharded stored value keeps
+    its layout through push (the merged grad moves TO the shards), and
+    pull hands out FULL values in the target's own sharding."""
+    mesh = _mesh()
+    kv = mx.kvstore.create("local")
+    w = np.arange(16, dtype=np.float32)
+    stored = NDArray(jax.device_put(jnp.asarray(w),
+                                    coll.zero1_sharding(mesh, (16,))))
+    kv.init(3, stored)
+    kv._store[3] = stored  # keep the sharded buffer as the master value
+
+    seen = {}
+
+    def updater(key, grad, weight):
+        seen["grad_spec"] = grad._data.sharding.spec
+        weight._data = weight._data - 0.1 * grad._data
+
+    kv.set_updater(updater)
+    grad = NDArray(jax.device_put(jnp.ones(16, jnp.float32),
+                                  NamedSharding(mesh, P())))
+    kv.push(3, grad)
+    # the stored master kept its 1/N layout; the grad was scattered to it
+    assert stored._data.sharding.spec == P("data")
+    assert seen["grad_spec"] == P("data")
+    out = NDArray(jax.device_put(jnp.zeros(16, jnp.float32),
+                                 NamedSharding(mesh, P())))
+    kv.pull(3, out)
+    assert out._data.sharding.spec == P()  # full values, never a bare shard
+    np.testing.assert_allclose(np.asarray(out._data), w - 0.1, rtol=1e-6)
+
+
+def test_sharded_update_env_opt_out(monkeypatch):
+    """MXNET_SHARDED_UPDATE=0 forces the replicated path even on a >1
+    data mesh; size-1 meshes never shard."""
+    mesh = _mesh()
+    assert coll.zero1_enabled(mesh)
+    monkeypatch.setenv("MXNET_SHARDED_UPDATE", "0")
+    assert not coll.zero1_enabled(mesh)
+    monkeypatch.delenv("MXNET_SHARDED_UPDATE")
+    assert not coll.zero1_enabled(None)
+    one = Mesh(np.array(jax.devices()[:1]), ("data",))
+    assert not coll.zero1_enabled(one)
