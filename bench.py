@@ -363,8 +363,93 @@ def run_transformer_config(batch=None, seq=None, iters=None, repeats=None,
     return rec
 
 
+def run_serving_config():
+    """Serving throughput/latency under synthetic concurrent load
+    (BENCH_MODEL=serving): BENCH_SERVING_THREADS clients each firing
+    1-row requests back-to-back through mxnet_tpu.serving's dynamic
+    batcher; the record is the server's own metrics surface (QPS,
+    latency percentiles, batch occupancy, padding efficiency, compile-
+    cache hit rate). Buckets/delay come from the MXNET_SERVING_* env
+    knobs (docs/env_var.md)."""
+    import threading
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+
+    n_requests = int(os.environ.get("BENCH_SERVING_REQUESTS", "256"))
+    n_threads = int(os.environ.get("BENCH_SERVING_THREADS", "16"))
+    in_dim, hidden, classes = 64, 256, 16
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    shapes, _, _ = sym.infer_shape(data=(1, in_dim))
+    params = {n: rng.uniform(-0.1, 0.1, s).astype(np.float32)
+              for n, s in zip(sym.list_arguments(), shapes)
+              if n not in ("data", "softmax_label")}
+
+    cfg = serving.ServingConfig()  # MXNET_SERVING_* env defaults
+    srv = serving.InferenceServer(sym, params, {"data": (in_dim,)},
+                                  config=cfg)
+    errors = []
+    per_thread = max(1, n_requests // n_threads)
+
+    def client(i):
+        r = np.random.RandomState(100 + i)
+        for _ in range(per_thread):
+            x = r.uniform(-1, 1, (1, in_dim)).astype(np.float32)
+            try:
+                srv.predict(data=x)
+            except serving.ServingError as e:
+                errors.append(e.code)
+
+    with srv:
+        # warm the compile cache outside the timed window so the record
+        # measures steady-state serving, not XLA compilation
+        srv.predict(data=np.zeros((1, in_dim), np.float32))
+        srv.metrics.reset()
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+    m = dict(zip(*srv.get_metrics()))
+    cache = srv.cache_stats()
+    total = cache["hits"] + cache["misses"]
+    return {
+        "metric": "serving_dynamic_batching_qps",
+        "value": round(m["completed"] / wall, 1),
+        "unit": "requests/sec",
+        "requests": int(m["completed"]),
+        "threads": n_threads,
+        "latency_ms_p50": round(m["latency_ms_p50"], 3),
+        "latency_ms_p95": round(m["latency_ms_p95"], 3),
+        "latency_ms_p99": round(m["latency_ms_p99"], 3),
+        "mean_batch_occupancy": round(m["mean_batch_occupancy"], 2),
+        "padding_efficiency": round(m["padding_efficiency"], 3),
+        "batches": int(m["batches"]),
+        "cache_hit_rate": round(cache["hits"] / total, 3) if total else None,
+        "compiles": cache["compiles"],
+        "buckets": list(cfg.buckets),
+        "max_delay_ms": cfg.max_delay_ms,
+        "client_errors": len(errors),
+        "model": "MLP %d-%d-%d softmax, 1-row requests"
+                 % (in_dim, hidden, classes),
+    }
+
+
 def main():
     which = os.environ.get("BENCH_MODEL", "both")
+    if which == "serving":
+        print(json.dumps(run_serving_config()))
+        return
     if os.environ.get("BENCH_LM_SWEEP"):
         # transformer (bs, seq) MFU table (docs/perf.md); one JSON line
         # per config, headline (bs32, seq2048) re-printed last

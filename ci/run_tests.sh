@@ -37,7 +37,15 @@ echo "== stage 4: multi-chip sharding dry-run (8 virtual devices) =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== stage 5: import hygiene =="
+echo "== stage 5: serving tests (dynamic batching + bucketed compile cache) =="
+# Dedicated pass over the inference-server suite: concurrency-sensitive
+# (batch former windows, deadlines, engine-dispatch pipelining), so it gets
+# its own stage where a hang or flake is attributable. Then the end-to-end
+# dry-run: concurrent clients -> occupancy/cache-hit assertions.
+JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
+JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; g.dryrun_serving()"
+
+echo "== stage 6: import hygiene =="
 python - <<'EOF'
 import os
 os.environ.setdefault("JAX_PLATFORMS", "cpu")

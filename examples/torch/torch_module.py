@@ -68,6 +68,11 @@ def main():
             logp = mx.nd.log_softmax(logits, axis=-1)
             loss = -(logp * mx.nd.array(onehot)).sum() / args.batch
         loss.backward()   # mx autograd -> custom-op bridge -> torch .grad
+        # backward dispatches asynchronously; the torch .grad accumulation
+        # happens inside that program's host callback. Fence on the input
+        # grad (an output of the same program) before opt.step() mutates
+        # the torch parameters in place, or step races the callback.
+        xb.grad.wait_to_read()
         opt.step()        # torch updates its own weights
         losses.append(float(loss.asnumpy()))
 

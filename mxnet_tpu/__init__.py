@@ -24,6 +24,7 @@ _PREDICT_ONLY = _os.environ.get("MXNET_PREDICT_ONLY", "") not in ("", "0")
 from . import executor
 from .executor import Executor
 from . import predict
+from . import serving
 from . import autograd   # transitive deps of the executor surface:
 from . import random     # bound unconditionally for consistency
 from .random import seed

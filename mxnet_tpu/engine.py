@@ -178,13 +178,35 @@ class NativeEngine:
 
 
 class PythonEngine:
-    """Pure-Python fallback with identical semantics (a NaiveEngine that
-    still honors the API — everything runs inline, like naive_engine.cc)."""
+    """Pure-Python fallback honoring the API. ``NaiveEngine`` (the default
+    here) runs everything inline, like naive_engine.cc. ``ThreadedEngine``
+    drains a FIFO on one daemon worker: ops still run in push order
+    (conservative — as if every op conflicted on a variable), but the
+    pushing thread is NOT blocked, so host pipelines (async checkpoint
+    writes, the serving batcher/dispatch split) overlap with the caller
+    even when the native library is unavailable."""
 
     def __init__(self, num_workers=0, engine_type="NaiveEngine"):
         self._next = 1
         self._prof = []
         self._profiling = False
+        self._queue = None
+        if engine_type != "NaiveEngine":
+            import queue
+
+            self._queue = queue.Queue()
+            threading.Thread(target=self._worker, daemon=True,
+                             name="mxtpu-py-engine").start()
+
+    def _worker(self):
+        while True:
+            fn = self._queue.get()
+            try:
+                fn()
+            except Exception:  # never kill the worker loop
+                traceback.print_exc()
+            finally:
+                self._queue.task_done()
 
     def new_variable(self):
         self._next += 1
@@ -193,7 +215,7 @@ class PythonEngine:
     def delete_variable(self, var):
         pass
 
-    def push(self, fn, const_vars=(), mutable_vars=(), priority=0, name="op"):
+    def _run_profiled(self, fn, name):
         import time
 
         t0 = time.time()
@@ -203,20 +225,36 @@ class PythonEngine:
                                "ts": int(t0 * 1e6),
                                "dur": int((time.time() - t0) * 1e6)})
 
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0, name="op"):
+        if self._queue is not None:
+            self._queue.put(lambda: self._run_profiled(fn, name))
+        else:
+            self._run_profiled(fn, name)
+
     def push_async(self, fn, const_vars=(), mutable_vars=(), priority=0,
                    name="op"):
-        done = threading.Event()
-        fn(done.set)
-        done.wait()
+        def run():
+            done = threading.Event()
+            fn(done.set)
+            done.wait()  # hold the FIFO slot until on_complete fires
+
+        if self._queue is not None:
+            self._queue.put(lambda: self._run_profiled(run, name))
+        else:
+            self._run_profiled(run, name)
 
     def wait_for_var(self, var):
-        pass
+        # conservative: the FIFO admits no reordering, so draining it is a
+        # correct (if coarse) WaitForVar
+        if self._queue is not None:
+            self._queue.join()
 
     def wait_for_all(self):
-        pass
+        if self._queue is not None:
+            self._queue.join()
 
     def pending(self):
-        return 0
+        return self._queue.unfinished_tasks if self._queue is not None else 0
 
     def set_profiling(self, on):
         self._profiling = bool(on)

@@ -31,15 +31,28 @@ from . import symbol as sym_mod
 from .base import MXNetError
 from .ndarray import NDArray
 
+# Process-wide count of XLA inference compilations (every Predictor
+# _compile). The serving bucket cache's steady-state contract — "no more
+# compilations than configured buckets" — is asserted against this.
+_COMPILE_COUNT = 0
+
+
+def compile_count() -> int:
+    """Number of Predictor XLA compilations in this process."""
+    return _COMPILE_COUNT
+
 
 class Predictor:
     """Inference-only executor (reference PredictorHandle)."""
 
     def __init__(self, symbol_json: str, params, input_shapes: Dict[str, tuple],
-                 dtype="float32"):
+                 dtype="float32", device=None):
         """``symbol_json``: JSON string or path. ``params``: path to a
         ``.params`` file or a dict of name→array (both ``arg:``/``aux:``
-        prefixed and bare names accepted, like MXPredCreate)."""
+        prefixed and bare names accepted, like MXPredCreate). ``device``:
+        optional jax device to compile for and run on (serving replicas
+        pin one executor per device; None = the default device)."""
+        self._device = device
         if os.path.exists(symbol_json):
             self._symbol = sym_mod.load(symbol_json)
         else:
@@ -88,6 +101,7 @@ class Predictor:
         self._compile()
 
     def _compile(self):
+        global _COMPILE_COUNT
         eval_fn = self._symbol.build_eval()
         param_vals = {n: a._data for n, a in self._arg_params.items()}
         aux_vals = {n: a._data for n, a in self._aux_params.items()}
@@ -104,8 +118,16 @@ class Predictor:
                                       jnp.dtype(self._dtype))
                  for n in input_names]
         # AOT compile now (MXPredCreate binds eagerly too)
-        self._lowered = self._jitted.lower(*specs)
-        self._exec = self._lowered.compile()
+        with self._device_scope():
+            self._lowered = self._jitted.lower(*specs)
+            self._exec = self._lowered.compile()
+        _COMPILE_COUNT += 1
+
+    def _device_scope(self):
+        import contextlib
+
+        return (jax.default_device(self._device) if self._device is not None
+                else contextlib.nullcontext())
 
     # --- reference API surface -------------------------------------------
     def set_input(self, name: str, value):
@@ -128,7 +150,9 @@ class Predictor:
             if self._inputs[n] is None:
                 raise MXNetError("input %r not set" % n)
             vals.append(self._inputs[n]._data.astype(jnp.dtype(self._dtype)))
-        outs = self._exec(*vals)
+        with self._device_scope():
+            outs = self._exec(*[jax.device_put(v, self._device) for v in vals]
+                              if self._device is not None else vals)
         self._outputs = [NDArray(o) for o in outs]
         return self._outputs
 
@@ -140,8 +164,11 @@ class Predictor:
     def output_names(self):
         return self._symbol.list_outputs()
 
-    def reshape(self, new_input_shapes: Dict[str, tuple]) -> "Predictor":
-        """MXPredReshape: rebind with new shapes, sharing weights."""
+    def reshape(self, new_input_shapes: Dict[str, tuple],
+                device=None) -> "Predictor":
+        """MXPredReshape: rebind with new shapes, sharing weights.
+        ``device`` optionally re-pins the new executor (serving replicas);
+        default inherits this predictor's device."""
         p = Predictor.__new__(Predictor)
         p._symbol = self._symbol
         p._arg_params = self._arg_params
@@ -149,6 +176,7 @@ class Predictor:
         p._input_names = list(new_input_shapes)
         p._input_shapes = {k: tuple(v) for k, v in new_input_shapes.items()}
         p._dtype = self._dtype
+        p._device = device if device is not None else self._device
         p._inputs = {n: None for n in p._input_shapes}
         p._outputs = []
         p._compile()
@@ -208,6 +236,10 @@ class ExportedPredictor:
                 raise MXNetError("input %r not provided" % n)
             v = inputs[n]
             arr = v._data if isinstance(v, NDArray) else jnp.asarray(v)
+            if tuple(arr.shape) != self._input_shapes[n]:
+                raise MXNetError(
+                    "input %r shape %s != exported shape %s"
+                    % (n, tuple(arr.shape), self._input_shapes[n]))
             vals.append(arr.astype(jnp.dtype(self._dtype)))
         outs = self._exported.call(*vals)
         if not isinstance(outs, (list, tuple)):

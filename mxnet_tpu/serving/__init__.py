@@ -1,0 +1,31 @@
+"""mxnet_tpu.serving — dynamic-batching inference server.
+
+The inference-workload half of the deployment story (docs/deployment.md
+"Serving"): concurrent single-example requests coalesce into micro-batches
+(``BatchFormer``), pad to the nearest configured batch bucket, and run
+through a bucketed compile cache (``BucketCache`` — one XLA program per
+bucket, parameters shared) dispatched via the host engine
+(``InferenceServer``), with QPS/latency/occupancy/cache metrics
+(``ServingMetrics``). Failures are structured ``ServingError``s.
+
+    from mxnet_tpu import serving
+
+    srv = serving.create_server("ckpt/m", epoch=1,
+                                example_shapes={"data": (3, 224, 224)},
+                                config=serving.ServingConfig(buckets=(1, 4, 8)))
+    with srv:
+        out = srv.predict(data=img[None])          # sync
+        req = srv.submit(data=img[None])           # async future
+        out = req.get(timeout=1.0)
+    print(srv.metrics.get_name_value())
+"""
+from .batcher import BatchFormer, Request, ServingError
+from .bucket_cache import BucketCache
+from .metrics import ServingBatchEndParam, ServingMetrics
+from .server import InferenceServer, ServingConfig, create_server
+
+__all__ = [
+    "BatchFormer", "Request", "ServingError", "BucketCache",
+    "ServingBatchEndParam", "ServingMetrics", "InferenceServer",
+    "ServingConfig", "create_server",
+]

@@ -1,0 +1,187 @@
+"""Request queue + micro-batch former for the serving subsystem.
+
+The TPU inversion of the reference's per-process CPU predictor
+(c_predict_api): throughput comes from coalescing concurrent requests
+into a few fixed shapes and keeping the device fed. ``BatchFormer`` is
+the coalescing stage — a bounded FIFO with per-request deadlines and a
+max-batch-size / max-queue-delay window (the standard dynamic-batching
+contract: dispatch as soon as ``max_batch`` rows are queued OR the oldest
+request has waited ``max_delay_ms``, whichever first).
+
+Failure is structured: every way a request can fail carries a
+``ServingError`` with a machine-readable ``code`` —
+
+- ``queue_full``         backpressure: the bounded queue rejected the submit
+- ``deadline_exceeded``  the request expired before dispatch
+- ``shutdown``           the server stopped while the request was queued
+- ``dispatch_error``     the compiled executor raised; the batch's requests
+                         all carry the cause
+- ``wait_timeout``       ``Request.get(timeout)`` gave up waiting
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+
+
+class ServingError(MXNetError):
+    """Structured serving failure; ``code`` is machine-readable (see module
+    docstring for the vocabulary)."""
+
+    def __init__(self, msg: str, code: str = "error"):
+        super().__init__(msg)
+        self.code = code
+
+
+class Request:
+    """One in-flight request: a dict of name -> np.ndarray with a leading
+    batch axis (usually 1 row; small batches ride whole — the former never
+    splits a request across micro-batches)."""
+
+    __slots__ = ("inputs", "rows", "deadline", "submitted", "latency_ms",
+                 "_event", "_outputs", "_error")
+
+    def __init__(self, inputs: Dict[str, np.ndarray], rows: int,
+                 deadline: Optional[float]):
+        self.inputs = inputs
+        self.rows = rows
+        self.deadline = deadline          # time.monotonic() absolute, or None
+        self.submitted = time.monotonic()
+        self.latency_ms: Optional[float] = None
+        self._event = threading.Event()
+        self._outputs: Optional[List[np.ndarray]] = None
+        self._error: Optional[BaseException] = None
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (self.deadline is not None
+                and (now if now is not None else time.monotonic())
+                >= self.deadline)
+
+    def set_result(self, outputs: List[np.ndarray]):
+        self.latency_ms = (time.monotonic() - self.submitted) * 1e3
+        self._outputs = outputs
+        self._event.set()
+
+    def set_error(self, err: BaseException):
+        self.latency_ms = (time.monotonic() - self.submitted) * 1e3
+        self._error = err
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def get(self, timeout: Optional[float] = None) -> List[np.ndarray]:
+        """Block for the result (``timeout`` in seconds). Raises the
+        request's ServingError on failure."""
+        if not self._event.wait(timeout):
+            raise ServingError("result not ready after %.3fs" % timeout,
+                               "wait_timeout")
+        if self._error is not None:
+            raise self._error
+        return self._outputs
+
+
+class BatchFormer:
+    """Bounded request queue + micro-batch former.
+
+    ``submit`` is the backpressure point: a full queue rejects immediately
+    (the caller sheds load or retries) rather than buffering unboundedly.
+    ``next_batch`` is the worker side: blocks for traffic, then holds the
+    window open up to ``max_delay_ms`` past the OLDEST queued request's
+    arrival while rows accumulate toward ``max_batch``. Expired requests
+    are failed (``deadline_exceeded``) at pop time and do not poison the
+    batch — the queue keeps draining.
+    """
+
+    def __init__(self, max_batch: int, max_delay_ms: float = 2.0,
+                 queue_depth: int = 256, error_hook=None):
+        if max_batch < 1 or queue_depth < 1:
+            raise ServingError("max_batch and queue_depth must be >= 1")
+        self.max_batch = int(max_batch)
+        self.max_delay = float(max_delay_ms) / 1e3
+        self.queue_depth = int(queue_depth)
+        self._error_hook = error_hook  # called with the code of each failure
+        self._q: deque = deque()
+        self._rows = 0  # queued rows (cached sum over self._q)
+        self._cond = threading.Condition()
+        self._closed = False
+
+    def _fail(self, req: Request, err: ServingError):
+        req.set_error(err)
+        if self._error_hook is not None:
+            self._error_hook(err.code)
+
+    def submit(self, req: Request):
+        with self._cond:
+            if self._closed:
+                raise ServingError("server is shut down", "shutdown")
+            if len(self._q) >= self.queue_depth:
+                raise ServingError(
+                    "queue full (%d requests; MXNET_SERVING_QUEUE_DEPTH)"
+                    % len(self._q), "queue_full")
+            self._q.append(req)
+            self._rows += req.rows
+            self._cond.notify()
+
+    def depth(self) -> int:
+        """Queued (not yet dispatched) request count — the live gauge."""
+        with self._cond:
+            return len(self._q)
+
+    def close(self):
+        """Stop admitting; wake the former loop so it can drain and exit."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def fail_pending(self, code: str = "shutdown",
+                     msg: str = "server stopped with the request queued"):
+        """Fail every queued request (post-close, non-draining stop)."""
+        with self._cond:
+            pending, self._q, self._rows = list(self._q), deque(), 0
+        for r in pending:
+            self._fail(r, ServingError(msg, code))
+
+    def next_batch(self) -> Optional[List[Request]]:
+        """Form the next micro-batch (>= 1 request, <= max_batch rows).
+        Returns None when closed and fully drained."""
+        while True:
+            with self._cond:
+                while not self._q and not self._closed:
+                    self._cond.wait()
+                if not self._q and self._closed:
+                    return None
+                # hold the window open from the head request's arrival;
+                # closed => dispatch whatever is queued immediately
+                t_end = self._q[0].submitted + self.max_delay
+                while (self._rows < self.max_batch and not self._closed):
+                    remaining = t_end - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                batch, rows, now = [], 0, time.monotonic()
+                while self._q:
+                    req = self._q[0]
+                    if req.expired(now):
+                        self._q.popleft()
+                        self._rows -= req.rows
+                        self._fail(req, ServingError(
+                            "deadline exceeded after %.1f ms in queue"
+                            % ((now - req.submitted) * 1e3),
+                            "deadline_exceeded"))
+                        continue
+                    if rows + req.rows > self.max_batch and batch:
+                        break  # next micro-batch takes it
+                    self._q.popleft()
+                    self._rows -= req.rows
+                    batch.append(req)
+                    rows += req.rows
+            if batch:
+                return batch
+            # every popped request had expired: go back to waiting

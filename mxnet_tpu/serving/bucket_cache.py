@@ -1,0 +1,99 @@
+"""Bucketed executor cache: one compiled XLA program per batch bucket.
+
+The BucketingModule discipline (module/bucketing_module.py: N symbols,
+ONE shared parameter set) applied to inference serving: requests are
+padded up to the nearest configured batch bucket, so steady-state traffic
+touches only len(buckets) compiled programs and never recompiles. Bucket
+executors are built lazily via ``Predictor.reshape`` — weights are shared
+by reference, only the XLA program is per-bucket — and the base
+predictor's own program is enrolled as its bucket, so a server over
+buckets (1, 4, 8) costs exactly three compilations, ever.
+
+This is the economics the TPU-compilation literature dictates (Fisher &
+Besard; "Operator Fusion in XLA"): XLA programs are shape-specialized, so
+serving must quantize shapes, not chase them.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from .batcher import ServingError
+
+
+class BucketCache:
+    """Lazy per-bucket executor cache over a base ``predict.Predictor``.
+
+    ``buckets`` are batch sizes along ``axis`` 0 of every input. The base
+    predictor must be bound at per-example shapes consistent with the
+    bucket shapes; if its batch size IS one of the buckets (the server
+    binds it at the smallest), its already-compiled program is reused —
+    enrollment is not a miss.
+    """
+
+    def __init__(self, base, buckets: Sequence[int], device=None):
+        if not buckets:
+            raise ServingError("at least one bucket batch size required")
+        self.buckets: List[int] = sorted(set(int(b) for b in buckets))
+        if self.buckets[0] < 1:
+            raise ServingError("bucket batch sizes must be >= 1")
+        self._base = base
+        self._device = device
+        self._lock = threading.Lock()
+        self._execs: Dict[int, object] = {}
+        self.hits = 0
+        self.misses = 0
+        self.compiles = 0
+        # enroll the base program if it is bound at a bucket batch size
+        base_batch = {s[0] for s in base._input_shapes.values()}
+        if len(base_batch) == 1 and next(iter(base_batch)) in self.buckets:
+            self._execs[next(iter(base_batch))] = base
+        # per-example shapes (batch axis stripped) for reshape
+        self._example_shapes = {n: tuple(s[1:])
+                                for n, s in base._input_shapes.items()}
+
+    @property
+    def max_batch(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, rows: int) -> int:
+        """Smallest bucket >= rows (the padding target)."""
+        for b in self.buckets:
+            if b >= rows:
+                return b
+        raise ServingError(
+            "request of %d rows exceeds the largest bucket (%d); raise "
+            "MXNET_SERVING_BUCKETS or split the request"
+            % (rows, self.buckets[-1]), "error")
+
+    def get(self, bucket: int):
+        """The compiled executor for ``bucket`` (compiling on first use)."""
+        with self._lock:
+            exe = self._execs.get(bucket)
+            if exe is not None:
+                self.hits += 1
+                return exe
+            if bucket not in self.buckets:
+                raise ServingError("%d is not a configured bucket (%s)"
+                                   % (bucket, self.buckets))
+            self.misses += 1
+            shapes = {n: (bucket,) + s
+                      for n, s in self._example_shapes.items()}
+            exe = self._base.reshape(shapes, device=self._device)
+            self.compiles += 1
+            self._execs[bucket] = exe
+            return exe
+
+    def warm(self):
+        """Precompile every bucket (trade startup time for tail latency)."""
+        for b in self.buckets:
+            with self._lock:
+                have = b in self._execs
+            if not have:
+                self.get(b)
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "compiles": self.compiles, "buckets": list(self.buckets),
+                    "compiled": sorted(self._execs)}

@@ -1,0 +1,122 @@
+"""Serving metrics surface.
+
+Follows metric.py's EvalMetric idiom — ``get()`` returns parallel
+name/value lists, ``get_name_value()`` zips them, ``reset()`` rezeroes —
+plus a batch-end callback hook in the callback.py Speedometer style: the
+server invokes ``batch_end_callback(ServingBatchEndParam(...))`` after
+every dispatched micro-batch.
+
+Tracked: QPS, p50/p95/p99 request latency, mean batch occupancy (real
+rows per dispatched batch), padding efficiency (real rows / padded bucket
+rows — the cost of the fixed-shape discipline), live queue depth, and the
+bucket cache's compile/hit/miss counters.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque, namedtuple
+from typing import Callable, Dict, List, Optional, Sequence
+
+ServingBatchEndParam = namedtuple(
+    "ServingBatchEndParam",
+    ["nbatch", "bucket", "rows", "replica", "latency_ms", "occupancy",
+     "metrics"])
+"""Passed to the server's batch_end_callback after each dispatched batch:
+batch ordinal, bucket size used, real rows, replica index, mean request
+latency of the batch (ms), rows (== occupancy of this batch), and the live
+ServingMetrics object."""
+
+
+def _percentile(sorted_vals: Sequence[float], q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1, max(0, int(round(
+        q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class ServingMetrics:
+    """Thread-safe serving counters with metric.py-style getters."""
+
+    #: ring-buffer size for latency percentiles (recent-window, not
+    #: whole-lifetime, so a warmup spike ages out)
+    LATENCY_WINDOW = 4096
+
+    def __init__(self, queue_depth_fn: Optional[Callable[[], int]] = None,
+                 cache_stats_fn: Optional[Callable[[], Dict]] = None):
+        self._lock = threading.Lock()
+        self._queue_depth_fn = queue_depth_fn
+        self._cache_stats_fn = cache_stats_fn
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self._t0 = time.monotonic()
+            self.n_submitted = 0
+            self.n_completed = 0
+            self.n_batches = 0
+            self.sum_rows = 0
+            self.sum_bucket_rows = 0
+            self.errors: Dict[str, int] = {}
+            self._lat: deque = deque(maxlen=self.LATENCY_WINDOW)
+
+    # --- recorders (called by the server/batcher) -------------------------
+    def record_submit(self, rows: int = 1):
+        with self._lock:
+            self.n_submitted += 1
+
+    def record_error(self, code: str):
+        with self._lock:
+            self.errors[code] = self.errors.get(code, 0) + 1
+
+    def record_batch(self, rows: int, bucket: int,
+                     latencies_ms: Sequence[float]):
+        with self._lock:
+            self.n_batches += 1
+            self.sum_rows += rows
+            self.sum_bucket_rows += bucket
+            self.n_completed += len(latencies_ms)
+            self._lat.extend(latencies_ms)
+
+    # --- metric.py-style surface ------------------------------------------
+    def get(self):
+        """(names, values), EvalMetric.get() shape."""
+        with self._lock:
+            dt = max(time.monotonic() - self._t0, 1e-9)
+            lat = sorted(self._lat)
+            names = ["qps", "latency_ms_p50", "latency_ms_p95",
+                     "latency_ms_p99", "mean_batch_occupancy",
+                     "padding_efficiency", "queue_depth", "requests",
+                     "completed", "batches", "errors"]
+            values = [
+                self.n_completed / dt,
+                _percentile(lat, 50), _percentile(lat, 95),
+                _percentile(lat, 99),
+                (self.sum_rows / self.n_batches) if self.n_batches
+                else float("nan"),
+                (self.sum_rows / self.sum_bucket_rows)
+                if self.sum_bucket_rows else float("nan"),
+                self._queue_depth_fn() if self._queue_depth_fn else 0,
+                self.n_submitted, self.n_completed, self.n_batches,
+                sum(self.errors.values()),
+            ]
+        if self._cache_stats_fn:
+            stats = self._cache_stats_fn()
+            for k in ("compile_cache_hits", "compile_cache_misses",
+                      "compiles"):
+                names.append(k)
+                values.append(stats.get(k.replace("compile_cache_", ""),
+                                        stats.get(k, 0)))
+        return names, values
+
+    def get_name_value(self):
+        names, values = self.get()
+        return list(zip(names, values))
+
+    def error_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.errors)
+
+    def __str__(self):
+        return "ServingMetrics: %s" % dict(self.get_name_value())

@@ -1,0 +1,302 @@
+"""In-process dynamic-batching inference server.
+
+Pipeline (each stage a host-engine op or dedicated thread, so they
+overlap — the engine.py division of labor applied to serving):
+
+    clients --submit--> BatchFormer (bounded queue, deadlines)
+                            |  former loop (thread): coalesce + pick bucket
+                            v
+             engine.push_async(dispatch, mutable_vars=[replica.var])
+                            |  engine worker: pad -> compiled XLA program
+                            v
+                 per-request result futures + ServingMetrics
+
+Dispatches to the SAME replica serialize on its engine variable (XLA
+programs on one device must anyway); dispatches to DIFFERENT replicas run
+concurrently on the native engine's worker pool — round-robin data
+parallelism over replica executors. The batch former keeps coalescing the
+next micro-batch while the engine runs the current one.
+
+Configuration comes from ``ServingConfig`` with ``MXNET_SERVING_*`` env
+defaults (docs/env_var.md; knob trade-offs in docs/deployment.md).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import engine
+from .. import predict as predict_mod
+from .batcher import BatchFormer, Request, ServingError
+from .bucket_cache import BucketCache
+from .metrics import ServingBatchEndParam, ServingMetrics
+
+
+def _env_buckets() -> tuple:
+    raw = os.environ.get("MXNET_SERVING_BUCKETS", "1,4,8")
+    return tuple(int(x) for x in raw.replace(" ", "").split(",") if x)
+
+
+@dataclass
+class ServingConfig:
+    """Batch-former / queue / replica knobs (env defaults read at
+    construction, docs/env_var.md)."""
+    buckets: Sequence[int] = field(default_factory=_env_buckets)
+    max_delay_ms: float = field(default_factory=lambda: float(
+        os.environ.get("MXNET_SERVING_MAX_DELAY_MS", "2.0")))
+    queue_depth: int = field(default_factory=lambda: int(
+        os.environ.get("MXNET_SERVING_QUEUE_DEPTH", "256")))
+    timeout_ms: float = field(default_factory=lambda: float(
+        os.environ.get("MXNET_SERVING_TIMEOUT_MS", "1000")))
+    replicas: int = field(default_factory=lambda: int(
+        os.environ.get("MXNET_SERVING_REPLICAS", "1")))
+    warm: bool = field(default_factory=lambda: bool(int(
+        os.environ.get("MXNET_SERVING_WARM", "0"))))
+
+
+class _Replica:
+    __slots__ = ("index", "cache", "var", "dispatched")
+
+    def __init__(self, index: int, cache: BucketCache, var: int):
+        self.index = index
+        self.cache = cache
+        self.var = var
+        self.dispatched = 0
+
+
+class InferenceServer:
+    """Dynamic-batching server over bucketed Predictor executors.
+
+    ``symbol``: Symbol, symbol-JSON string, or path. ``params``: params
+    path or dict (Predictor semantics). ``example_shapes``: per-example
+    input shapes WITHOUT the batch axis, e.g. ``{"data": (3, 224, 224)}``.
+    ``devices``: optional jax devices, one replica pinned per device
+    (round-robin dispatch); default all replicas on the default device.
+    """
+
+    def __init__(self, symbol, params, example_shapes: Dict[str, tuple],
+                 dtype: str = "float32",
+                 config: Optional[ServingConfig] = None,
+                 batch_end_callback: Optional[Callable] = None,
+                 devices: Optional[Sequence] = None):
+        self.config = config or ServingConfig()
+        if not self.config.buckets:
+            raise ServingError("no buckets configured")
+        self._example_shapes = {n: tuple(s)
+                                for n, s in example_shapes.items()}
+        self._input_names = list(self._example_shapes)
+        self._batch_end_callback = batch_end_callback
+        symbol_json = symbol.tojson() if hasattr(symbol, "tojson") else symbol
+
+        n_rep = max(1, int(self.config.replicas))
+        if devices is not None and len(devices) < n_rep:
+            raise ServingError("need %d devices for %d replicas, got %d"
+                               % (n_rep, n_rep, len(devices)))
+        smallest = sorted(set(int(b) for b in self.config.buckets))[0]
+        self._replicas: List[_Replica] = []
+        for i in range(n_rep):
+            dev = devices[i] if devices is not None else None
+            base = predict_mod.Predictor(
+                symbol_json, params,
+                {n: (smallest,) + s for n, s in self._example_shapes.items()},
+                dtype=dtype, device=dev)
+            cache = BucketCache(base, self.config.buckets, device=dev)
+            self._replicas.append(
+                _Replica(i, cache, engine.new_variable()))
+        self._rr = 0
+
+        self.metrics = ServingMetrics(cache_stats_fn=self._cache_stats)
+        self._former = BatchFormer(
+            max_batch=max(self.config.buckets),
+            max_delay_ms=self.config.max_delay_ms,
+            queue_depth=self.config.queue_depth,
+            error_hook=self.metrics.record_error)
+        self.metrics._queue_depth_fn = self._former.depth
+        self._nbatch = 0
+        self._thread: Optional[threading.Thread] = None
+        self._started = False
+        if self.config.warm:
+            for rep in self._replicas:
+                rep.cache.warm()
+
+    # --- cache stats aggregated over replicas -----------------------------
+    def _cache_stats(self) -> Dict:
+        agg = {"hits": 0, "misses": 0, "compiles": 0}
+        for rep in self._replicas:
+            s = rep.cache.stats()
+            for k in agg:
+                agg[k] += s[k]
+        return agg
+
+    # --- lifecycle --------------------------------------------------------
+    def start(self) -> "InferenceServer":
+        if self._started:
+            return self
+        self._started = True
+        self._thread = threading.Thread(target=self._former_loop,
+                                        daemon=True, name="serving-former")
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True):
+        """Stop the server. ``drain=True`` serves everything already
+        queued first; ``drain=False`` fails queued requests with a
+        ``shutdown`` ServingError. In-flight dispatches always finish."""
+        if not self._started:
+            self._former.close()
+            self._former.fail_pending()
+            return
+        if not drain:
+            self._former.close()
+            self._former.fail_pending()
+        else:
+            self._former.close()
+        self._thread.join()
+        for rep in self._replicas:
+            engine.wait_for_var(rep.var)
+            engine.delete_variable(rep.var)
+        self._started = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop(drain=not any(exc))
+
+    # --- client surface ---------------------------------------------------
+    def submit(self, timeout_ms: Optional[float] = None,
+               **inputs) -> Request:
+        """Enqueue one request (arrays WITH a leading batch axis; 1-row
+        requests are the common case). Returns a Request future —
+        ``req.get()`` blocks for the result. Raises ServingError
+        immediately on backpressure (``queue_full``) or shutdown."""
+        rows = None
+        feed = {}
+        for name in self._input_names:
+            if name not in inputs:
+                raise ServingError("missing input %r (need %s)"
+                                   % (name, self._input_names))
+            arr = np.asarray(inputs[name])
+            want = self._example_shapes[name]
+            if arr.ndim != len(want) + 1 or tuple(arr.shape[1:]) != want:
+                raise ServingError(
+                    "input %r shape %s != (rows,)+%s"
+                    % (name, arr.shape, want))
+            if rows is None:
+                rows = arr.shape[0]
+            elif arr.shape[0] != rows:
+                raise ServingError("inconsistent row counts across inputs")
+            feed[name] = arr
+        if rows < 1:
+            raise ServingError("empty request")
+        max_rows = max(self.config.buckets)
+        if rows > max_rows:
+            raise ServingError(
+                "request of %d rows exceeds the largest bucket (%d)"
+                % (rows, max_rows))
+        t = self.config.timeout_ms if timeout_ms is None else timeout_ms
+        deadline = (time.monotonic() + t / 1e3) if t and t > 0 else None
+        req = Request(feed, rows, deadline)
+        self.metrics.record_submit(rows)
+        try:
+            self._former.submit(req)
+        except ServingError as e:
+            self.metrics.record_error(e.code)
+            raise
+        return req
+
+    def predict(self, timeout_ms: Optional[float] = None,
+                **inputs) -> List[np.ndarray]:
+        """Synchronous convenience: submit + wait."""
+        req = self.submit(timeout_ms=timeout_ms, **inputs)
+        # grace over the queue deadline so a request failed by the former
+        # surfaces its own (structured) error rather than a wait_timeout
+        t = self.config.timeout_ms if timeout_ms is None else timeout_ms
+        wait = (t / 1e3 + 60.0) if t and t > 0 else None
+        return req.get(wait)
+
+    # --- former loop + dispatch -------------------------------------------
+    def _former_loop(self):
+        while True:
+            batch = self._former.next_batch()
+            if batch is None:
+                return
+            rep = self._replicas[self._rr % len(self._replicas)]
+            self._rr += 1
+            self._nbatch += 1
+            nbatch = self._nbatch
+            engine.push_async(
+                lambda done, batch=batch, rep=rep, nbatch=nbatch:
+                    self._dispatch(batch, rep, nbatch, done),
+                mutable_vars=[rep.var],
+                name="serving_dispatch_r%d" % rep.index)
+
+    def _dispatch(self, batch: List[Request], rep: _Replica, nbatch: int,
+                  on_complete: Callable[[], None]):
+        try:
+            rows = sum(r.rows for r in batch)
+            bucket = rep.cache.bucket_for(rows)
+            exe = rep.cache.get(bucket)
+            feed = {}
+            for name in self._input_names:
+                cat = np.concatenate([r.inputs[name] for r in batch], axis=0)
+                if bucket > rows:
+                    pad = np.zeros((bucket - rows,) + cat.shape[1:],
+                                   cat.dtype)
+                    cat = np.concatenate([cat, pad], axis=0)
+                feed[name] = cat
+            outs = [o.asnumpy() for o in exe.forward(**feed)]
+            for o in outs:
+                if o.shape[:1] != (bucket,):
+                    raise ServingError(
+                        "output batch axis %s != bucket %d — serving "
+                        "requires batch-major outputs" % (o.shape, bucket))
+            offset = 0
+            lats = []
+            for r in batch:
+                r.set_result([o[offset:offset + r.rows] for o in outs])
+                offset += r.rows
+                lats.append(r.latency_ms)
+            rep.dispatched += 1
+            self.metrics.record_batch(rows, bucket, lats)
+            if self._batch_end_callback is not None:
+                self._batch_end_callback(ServingBatchEndParam(
+                    nbatch=nbatch, bucket=bucket, rows=rows,
+                    replica=rep.index,
+                    latency_ms=sum(lats) / len(lats), occupancy=rows,
+                    metrics=self.metrics))
+        except BaseException as e:
+            err = e if isinstance(e, ServingError) else ServingError(
+                "dispatch failed: %s: %s" % (type(e).__name__, e),
+                "dispatch_error")
+            self.metrics.record_error(err.code)
+            for r in batch:
+                if not r.done():
+                    r.set_error(err)
+        finally:
+            on_complete()
+
+    # --- introspection ----------------------------------------------------
+    def get_metrics(self):
+        """metric.py-style (names, values) snapshot."""
+        return self.metrics.get()
+
+    def cache_stats(self) -> Dict:
+        return self._cache_stats()
+
+    def replica_dispatch_counts(self) -> List[int]:
+        return [rep.dispatched for rep in self._replicas]
+
+
+def create_server(prefix: str, epoch: int, example_shapes: Dict[str, tuple],
+                  dtype: str = "float32", **kwargs) -> InferenceServer:
+    """Server straight from a training checkpoint pair (predict.create
+    analogue): ``prefix-symbol.json`` + ``prefix-%04d.params``."""
+    return InferenceServer("%s-symbol.json" % prefix,
+                           "%s-%04d.params" % (prefix, epoch),
+                           example_shapes, dtype=dtype, **kwargs)

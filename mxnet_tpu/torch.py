@@ -21,6 +21,7 @@ TORCH_PATH, make/config.mk).
 """
 from __future__ import annotations
 
+import threading
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
@@ -43,6 +44,15 @@ def _require_torch():
     return _th
 
 
+# XLA's CPU runtime may invoke host callbacks concurrently from several
+# execution threads, but torch autograd state (module parameters, .grad
+# accumulation, tensor version counters) is not safe under concurrent
+# forward/backward of the SAME module — symptoms range from
+# "cannot call bump_version() on undefined tensor" to segfaults. One
+# process-wide lock serializes every torch-op callback.
+_TH_LOCK = threading.RLock()
+
+
 def _to_torch(a: np.ndarray, requires_grad: bool):
     t = _th.from_numpy(np.ascontiguousarray(a))
     if requires_grad and t.is_floating_point():
@@ -57,28 +67,30 @@ class _TorchModuleOp(_operator.CustomOp):
         self.module = module
 
     def forward(self, is_train, req, in_data, out_data, aux):
-        xs = [_to_torch(np.asarray(x), False) for x in in_data]
-        with _th.no_grad():
-            out = self.module(*xs)
-        outs = out if isinstance(out, (tuple, list)) else (out,)
-        for i, (dst, src) in enumerate(zip(out_data, outs)):
-            self.assign(dst, req[i] if isinstance(req, (list, tuple)) else req,
-                        src.detach().numpy())
+        with _TH_LOCK:
+            xs = [_to_torch(np.asarray(x), False) for x in in_data]
+            with _th.no_grad():
+                out = self.module(*xs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for i, (dst, src) in enumerate(zip(out_data, outs)):
+                self.assign(dst,
+                            req[i] if isinstance(req, (list, tuple)) else req,
+                            src.detach().numpy())
 
     def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
-        xs = [_to_torch(np.asarray(x), True) for x in in_data]
-        params = [p for p in self.module.parameters() if p.requires_grad]
-        out = self.module(*xs)
-        outs = out if isinstance(out, (tuple, list)) else (out,)
-        gs = [_th.from_numpy(np.ascontiguousarray(np.asarray(g)))
-              for g in out_grad[:len(outs)]]
-        _th.autograd.backward(list(outs), gs)
-        for i, (dst, x) in enumerate(zip(in_grad, xs)):
-            g = x.grad
-            r = req[i] if isinstance(req, (list, tuple)) else req
-            self.assign(dst, r,
-                        g.numpy() if g is not None
-                        else np.zeros_like(np.asarray(in_data[i])))
+        with _TH_LOCK:
+            xs = [_to_torch(np.asarray(x), True) for x in in_data]
+            out = self.module(*xs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            gs = [_th.from_numpy(np.ascontiguousarray(np.asarray(g)))
+                  for g in out_grad[:len(outs)]]
+            _th.autograd.backward(list(outs), gs)
+            for i, (dst, x) in enumerate(zip(in_grad, xs)):
+                g = x.grad
+                r = req[i] if isinstance(req, (list, tuple)) else req
+                self.assign(dst, r,
+                            g.numpy() if g is not None
+                            else np.zeros_like(np.asarray(in_data[i])))
         # torch-side parameters train in place with torch's own grads; an
         # explicit torch optimizer step is the user's choice (the reference
         # likewise leaves Torch module weights to Torch, torch_module.cc)
@@ -92,33 +104,37 @@ class _TorchFunctionOp(_operator.CustomOp):
         self.num_outputs = num_outputs
 
     def forward(self, is_train, req, in_data, out_data, aux):
-        xs = [_to_torch(np.asarray(x), False) for x in in_data]
-        with _th.no_grad():
-            out = self.fn(*xs)
-        outs = out if isinstance(out, (tuple, list)) else (out,)
-        for i, (dst, src) in enumerate(zip(out_data, outs)):
-            r = req[i] if isinstance(req, (list, tuple)) else req
-            self.assign(dst, r, src.detach().numpy())
+        with _TH_LOCK:
+            xs = [_to_torch(np.asarray(x), False) for x in in_data]
+            with _th.no_grad():
+                out = self.fn(*xs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for i, (dst, src) in enumerate(zip(out_data, outs)):
+                r = req[i] if isinstance(req, (list, tuple)) else req
+                self.assign(dst, r, src.detach().numpy())
 
     def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
-        xs = [_to_torch(np.asarray(x), True) for x in in_data]
-        out = self.fn(*xs)
-        outs = out if isinstance(out, (tuple, list)) else (out,)
-        gs = [_th.from_numpy(np.ascontiguousarray(np.asarray(g)))
-              for g in out_grad[:len(outs)]]
-        diff = [x for x in xs if x.requires_grad]
-        grads = (_th.autograd.grad(list(outs), diff, gs, allow_unused=True)
-                 if diff else ())
-        it = iter(grads)
-        for i, (dst, x) in enumerate(zip(in_grad, xs)):
-            r = req[i] if isinstance(req, (list, tuple)) else req
-            if x.requires_grad:
-                g = next(it)
-                self.assign(dst, r,
-                            g.numpy() if g is not None
-                            else np.zeros_like(np.asarray(in_data[i])))
-            else:
-                self.assign(dst, r, np.zeros_like(np.asarray(in_data[i])))
+        with _TH_LOCK:
+            xs = [_to_torch(np.asarray(x), True) for x in in_data]
+            out = self.fn(*xs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            gs = [_th.from_numpy(np.ascontiguousarray(np.asarray(g)))
+                  for g in out_grad[:len(outs)]]
+            diff = [x for x in xs if x.requires_grad]
+            grads = (_th.autograd.grad(list(outs), diff, gs,
+                                       allow_unused=True)
+                     if diff else ())
+            it = iter(grads)
+            for i, (dst, x) in enumerate(zip(in_grad, xs)):
+                r = req[i] if isinstance(req, (list, tuple)) else req
+                if x.requires_grad:
+                    g = next(it)
+                    self.assign(dst, r,
+                                g.numpy() if g is not None
+                                else np.zeros_like(np.asarray(in_data[i])))
+                else:
+                    self.assign(dst, r,
+                                np.zeros_like(np.asarray(in_data[i])))
 
 
 def _infer_by_tracing(module_or_fn, in_shape, num_outputs):

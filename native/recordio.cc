@@ -196,8 +196,13 @@ void Resize(const std::vector<uint8_t>& src, int sw, int sh,
 // ----------------------------------------------------- augment transforms --
 // Rotate an RGB u8 image about its center by `angle` degrees, same output
 // size, constant `fill` border (the reference affine at scale=1/shear=0:
-// src/io/image_aug_default.cc:215-246). Inverse-mapped bilinear sampling,
-// matching cv::warpAffine(INTER_LINEAR, BORDER_CONSTANT).
+// src/io/image_aug_default.cc:215-246). Inverse-mapped bilinear sampling
+// replicating cv::warpAffine(INTER_LINEAR, BORDER_CONSTANT)'s fixed-point
+// pipeline: source coordinates accumulate from per-term products rounded
+// at 1/1024 px (AB_BITS=10), are re-quantized to 1/32 px (INTER_BITS=5),
+// and the four tap weights are 15-bit fixed point with a
+// round-to-nearest accumulate — exact-float bilinear drifts up to ±6
+// counts from this path.
 void RotateU8(const uint8_t* src, int w, int h, float angle, int fill,
               uint8_t* dst) {
   float a = std::cos(angle / 180.0f * (float)M_PI);
@@ -205,29 +210,43 @@ void RotateU8(const uint8_t* src, int w, int h, float angle, int fill,
   // forward M = [[a, b, tx], [-b, a, ty]] with the centering translation
   float tx = (w - (a * w + b * h)) / 2.0f;
   float ty = (h - (-b * w + a * h)) / 2.0f;
-  // inverse of a pure rotation+translation: R^T, -R^T t
+  // invert the float32 forward matrix numerically in double, exactly like
+  // cv::invertAffineTransform (the analytic R^T inverse assumes det==1 and
+  // flips round-to-nearest ties on ~0.03% of pixels)
+  double M00 = a, M01 = b, M02 = tx, M10 = -b, M11 = a, M12 = ty;
+  double D = M00 * M11 - M01 * M10;
+  D = D != 0 ? 1.0 / D : 0.0;
+  double i00 = M11 * D, i01 = -M01 * D, i10 = -M10 * D, i11 = M00 * D;
+  double i02 = -i00 * M02 - i01 * M12;
+  double i12 = -i10 * M02 - i11 * M12;
+  const int AB_BITS = 10, INTER_BITS = 5;
+  const double AB_SCALE = 1 << AB_BITS;
+  const int ROUND_DELTA = 1 << (AB_BITS - INTER_BITS - 1);
   for (int y = 0; y < h; ++y) {
+    int X0 = (int)std::lrint((i01 * y + i02) * AB_SCALE) + ROUND_DELTA;
+    int Y0 = (int)std::lrint((i11 * y + i12) * AB_SCALE) + ROUND_DELTA;
     for (int x = 0; x < w; ++x) {
-      float sx = a * (x - tx) + (-b) * (y - ty);
-      float sy = b * (x - tx) + a * (y - ty);
+      int X = (X0 + (int)std::lrint(i00 * x * AB_SCALE)) >>
+              (AB_BITS - INTER_BITS);
+      int Y = (Y0 + (int)std::lrint(i10 * x * AB_SCALE)) >>
+              (AB_BITS - INTER_BITS);
+      int x0 = X >> INTER_BITS, y0 = Y >> INTER_BITS;
+      float wx = (X & 31) / 32.0f, wy = (Y & 31) / 32.0f;
+      int iw00 = (int)std::lrint((1 - wy) * (1 - wx) * 32768.0f);
+      int iw01 = (int)std::lrint((1 - wy) * wx * 32768.0f);
+      int iw10 = (int)std::lrint(wy * (1 - wx) * 32768.0f);
+      int iw11 = 32768 - iw00 - iw01 - iw10;  // cv normalizes the tab sum
       uint8_t* out = dst + ((size_t)y * w + x) * 3;
-      if (sx < -1 || sy < -1 || sx >= w || sy >= h) {
-        out[0] = out[1] = out[2] = (uint8_t)fill;
-        continue;
-      }
-      int x0 = (int)std::floor(sx), y0 = (int)std::floor(sy);
-      float wx = sx - x0, wy = sy - y0;
       for (int c = 0; c < 3; ++c) {
         // sample with constant fill outside the source
-        auto at = [&](int yy, int xx) -> float {
-          if (xx < 0 || yy < 0 || xx >= w || yy >= h) return (float)fill;
+        auto at = [&](int yy, int xx) -> int {
+          if (xx < 0 || yy < 0 || xx >= w || yy >= h) return fill;
           return src[((size_t)yy * w + xx) * 3 + c];
         };
-        float v = at(y0, x0) * (1 - wy) * (1 - wx) +
-                  at(y0, x0 + 1) * (1 - wy) * wx +
-                  at(y0 + 1, x0) * wy * (1 - wx) +
-                  at(y0 + 1, x0 + 1) * wy * wx;
-        out[c] = (uint8_t)(v + 0.5f);
+        int v = at(y0, x0) * iw00 + at(y0, x0 + 1) * iw01 +
+                at(y0 + 1, x0) * iw10 + at(y0 + 1, x0 + 1) * iw11;
+        v = (v + (1 << 14)) >> 15;
+        out[c] = (uint8_t)(v < 0 ? 0 : (v > 255 ? 255 : v));
       }
     }
   }

@@ -364,7 +364,11 @@ def test_launcher_local_ps_topology_end_to_end():
     import sys as _sys
 
     launch = os.path.join(REPO, "tools", "launch.py")
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # widen the worker->server connect window: under a fully loaded CI
+    # host the 5 spawned interpreters can take >60s (the default) to all
+    # reach their sockets, which flaked this test at suite-load
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXNET_TPU_PS_CONNECT_TIMEOUT="180")
     p = subprocess.run(
         [_sys.executable, launch, "-n", "3", "-s", "2", "--launcher",
          "local", _sys.executable, SCRIPT],

@@ -86,6 +86,41 @@ def test_predictor_reshape():
             pred.forward(data=np.zeros((5, 10), np.float32))
 
 
+def test_predictor_reshape_numeric_and_param_sharing():
+    # The reshape path must produce the same function at a second input
+    # shape — same params, same math — not just the right output shape.
+    with tempfile.TemporaryDirectory() as tmp:
+        prefix, _ = _make_checkpoint(tmp)
+        pred = mx.predict.create(prefix, 1, {"data": (4, 10)})
+        p2 = pred.reshape({"data": (7, 10)})
+        # params are shared by reference (c_predict_api MXPredReshape
+        # contract), not copied
+        assert p2._arg_params is pred._arg_params
+        x7 = np.random.randn(7, 10).astype(np.float32)
+        out7 = p2.forward(data=x7)[0].asnumpy()
+        # row-independent net: the first 4 rows through the original
+        # (4, 10) program must match the same rows of the (7, 10) program
+        out4 = pred.forward(data=x7[:4])[0].asnumpy()
+        np.testing.assert_allclose(out7[:4], out4, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_reshape_then_export_roundtrip():
+    # export/load must capture the reshaped program, not the original
+    with tempfile.TemporaryDirectory() as tmp:
+        prefix, _ = _make_checkpoint(tmp)
+        pred = mx.predict.create(prefix, 1, {"data": (4, 10)})
+        p2 = pred.reshape({"data": (2, 10)})
+        x = np.random.randn(2, 10).astype(np.float32)
+        ref = p2.forward(data=x)[0].asnumpy()
+        art = os.path.join(tmp, "artifact2")
+        p2.export(art)
+        loaded = mx.predict.load(art)
+        out = loaded.forward(data=x)[0].asnumpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+        with pytest.raises(mx.MXNetError):
+            loaded.forward(data=np.zeros((4, 10), np.float32))
+
+
 def test_predictor_export_roundtrip():
     with tempfile.TemporaryDirectory() as tmp:
         prefix, _ = _make_checkpoint(tmp)
