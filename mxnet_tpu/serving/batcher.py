@@ -12,6 +12,8 @@ Failure is structured: every way a request can fail carries a
 ``ServingError`` with a machine-readable ``code`` —
 
 - ``queue_full``         backpressure: the bounded queue rejected the submit
+- ``too_large``          the request's rows exceed ``max_batch``; it could
+                         never be dispatched, so submit rejects it
 - ``deadline_exceeded``  the request expired before dispatch
 - ``shutdown``           the server stopped while the request was queued
 - ``dispatch_error``     the compiled executor raised; the batch's requests
@@ -118,6 +120,11 @@ class BatchFormer:
             self._error_hook(err.code)
 
     def submit(self, req: Request):
+        if req.rows > self.max_batch:
+            raise ServingError(
+                "request of %d rows exceeds max_batch (%d); split it or "
+                "raise the largest bucket" % (req.rows, self.max_batch),
+                "too_large")
         with self._cond:
             if self._closed:
                 raise ServingError("server is shut down", "shutdown")
@@ -133,6 +140,10 @@ class BatchFormer:
         """Queued (not yet dispatched) request count — the live gauge."""
         with self._cond:
             return len(self._q)
+
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed
 
     def close(self):
         """Stop admitting; wake the former loop so it can drain and exit."""
@@ -152,6 +163,7 @@ class BatchFormer:
         """Form the next micro-batch (>= 1 request, <= max_batch rows).
         Returns None when closed and fully drained."""
         while True:
+            expired: List[Request] = []
             with self._cond:
                 while not self._q and not self._closed:
                     self._cond.wait()
@@ -171,10 +183,7 @@ class BatchFormer:
                     if req.expired(now):
                         self._q.popleft()
                         self._rows -= req.rows
-                        self._fail(req, ServingError(
-                            "deadline exceeded after %.1f ms in queue"
-                            % ((now - req.submitted) * 1e3),
-                            "deadline_exceeded"))
+                        expired.append(req)
                         continue
                     if rows + req.rows > self.max_batch and batch:
                         break  # next micro-batch takes it
@@ -182,6 +191,12 @@ class BatchFormer:
                     self._rows -= req.rows
                     batch.append(req)
                     rows += req.rows
+            # fail outside _cond: the error hook may take other locks
+            # (e.g. ServingMetrics._lock, whose holder may call depth())
+            for req in expired:
+                self._fail(req, ServingError(
+                    "deadline exceeded after %.1f ms in queue"
+                    % ((now - req.submitted) * 1e3), "deadline_exceeded"))
             if batch:
                 return batch
             # every popped request had expired: go back to waiting

@@ -82,6 +82,10 @@ class ServingMetrics:
     # --- metric.py-style surface ------------------------------------------
     def get(self):
         """(names, values), EvalMetric.get() shape."""
+        # read the gauge BEFORE taking _lock: depth() takes the former's
+        # condition, and the former calls record_error (which takes _lock)
+        # — nesting them here would order the locks ABBA
+        depth = self._queue_depth_fn() if self._queue_depth_fn else 0
         with self._lock:
             dt = max(time.monotonic() - self._t0, 1e-9)
             lat = sorted(self._lat)
@@ -97,7 +101,7 @@ class ServingMetrics:
                 else float("nan"),
                 (self.sum_rows / self.sum_bucket_rows)
                 if self.sum_bucket_rows else float("nan"),
-                self._queue_depth_fn() if self._queue_depth_fn else 0,
+                depth,
                 self.n_submitted, self.n_completed, self.n_batches,
                 sum(self.errors.values()),
             ]

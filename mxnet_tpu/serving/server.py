@@ -22,6 +22,7 @@ defaults (docs/env_var.md; knob trade-offs in docs/deployment.md).
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -111,18 +112,22 @@ class InferenceServer:
         self._rr = 0
 
         self.metrics = ServingMetrics(cache_stats_fn=self._cache_stats)
-        self._former = BatchFormer(
-            max_batch=max(self.config.buckets),
-            max_delay_ms=self.config.max_delay_ms,
-            queue_depth=self.config.queue_depth,
-            error_hook=self.metrics.record_error)
-        self.metrics._queue_depth_fn = self._former.depth
+        self._former = self._make_former()
         self._nbatch = 0
         self._thread: Optional[threading.Thread] = None
         self._started = False
         if self.config.warm:
             for rep in self._replicas:
                 rep.cache.warm()
+
+    def _make_former(self) -> BatchFormer:
+        former = BatchFormer(
+            max_batch=max(self.config.buckets),
+            max_delay_ms=self.config.max_delay_ms,
+            queue_depth=self.config.queue_depth,
+            error_hook=self.metrics.record_error)
+        self.metrics._queue_depth_fn = former.depth
+        return former
 
     # --- cache stats aggregated over replicas -----------------------------
     def _cache_stats(self) -> Dict:
@@ -135,8 +140,17 @@ class InferenceServer:
 
     # --- lifecycle --------------------------------------------------------
     def start(self) -> "InferenceServer":
+        """Start (or restart) the former loop. A stopped server restarts
+        cleanly: close() is permanent on a BatchFormer, so a fresh one is
+        built, and replica engine variables deleted by stop() are
+        re-issued."""
         if self._started:
             return self
+        if self._former.closed():
+            self._former = self._make_former()
+            for rep in self._replicas:
+                if rep.var is None:
+                    rep.var = engine.new_variable()
         self._started = True
         self._thread = threading.Thread(target=self._former_loop,
                                         daemon=True, name="serving-former")
@@ -160,6 +174,7 @@ class InferenceServer:
         for rep in self._replicas:
             engine.wait_for_var(rep.var)
             engine.delete_variable(rep.var)
+            rep.var = None
         self._started = False
 
     def __enter__(self):
@@ -198,7 +213,7 @@ class InferenceServer:
         if rows > max_rows:
             raise ServingError(
                 "request of %d rows exceeds the largest bucket (%d)"
-                % (rows, max_rows))
+                % (rows, max_rows), "too_large")
         t = self.config.timeout_ms if timeout_ms is None else timeout_ms
         deadline = (time.monotonic() + t / 1e3) if t and t > 0 else None
         req = Request(feed, rows, deadline)
@@ -265,11 +280,18 @@ class InferenceServer:
             rep.dispatched += 1
             self.metrics.record_batch(rows, bucket, lats)
             if self._batch_end_callback is not None:
-                self._batch_end_callback(ServingBatchEndParam(
-                    nbatch=nbatch, bucket=bucket, rows=rows,
-                    replica=rep.index,
-                    latency_ms=sum(lats) / len(lats), occupancy=rows,
-                    metrics=self.metrics))
+                # every request already completed: a raising user callback
+                # must not be recorded as a dispatch failure
+                try:
+                    self._batch_end_callback(ServingBatchEndParam(
+                        nbatch=nbatch, bucket=bucket, rows=rows,
+                        replica=rep.index,
+                        latency_ms=sum(lats) / len(lats), occupancy=rows,
+                        metrics=self.metrics))
+                except Exception:
+                    logging.getLogger("mxnet_tpu").exception(
+                        "serving batch_end_callback raised (batch %d)",
+                        nbatch)
         except BaseException as e:
             err = e if isinstance(e, ServingError) else ServingError(
                 "dispatch failed: %s: %s" % (type(e).__name__, e),

@@ -186,12 +186,41 @@ def test_stop_without_drain_fails_queued_shutdown():
 
 def test_request_validation():
     srv, _, _ = _server(buckets=(1, 2))
-    with pytest.raises(ServingError):
+    with pytest.raises(ServingError) as ei:
         srv.submit(data=np.zeros((3, 10), np.float32))  # > largest bucket
+    assert ei.value.code == "too_large"
     with pytest.raises(ServingError):
         srv.submit(data=np.zeros((1, 7), np.float32))   # wrong shape
     with pytest.raises(ServingError):
         srv.submit(nope=np.zeros((1, 10), np.float32))  # wrong name
+    srv.stop()
+
+
+def test_batch_former_rejects_oversized_request():
+    # standalone BatchFormer use: an undispatchable request is rejected at
+    # submit time, never admitted into an oversized micro-batch
+    from mxnet_tpu.serving.batcher import BatchFormer, Request
+
+    f = BatchFormer(max_batch=2, max_delay_ms=1.0, queue_depth=16)
+    with pytest.raises(ServingError) as ei:
+        f.submit(Request({}, 3, None))
+    assert ei.value.code == "too_large"
+    assert f.depth() == 0
+    f.close()
+
+
+# --- restart after stop ------------------------------------------------------
+
+def test_start_after_stop_restarts_cleanly():
+    srv, _, _ = _server(buckets=(1, 2))
+    x = np.zeros((1, 10), np.float32)
+    with srv:
+        assert srv.predict(data=x)[0].shape == (1, 3)
+    with pytest.raises(ServingError) as ei:  # stopped: submits rejected
+        srv.submit(data=x)
+    assert ei.value.code == "shutdown"
+    srv.start()  # rebuilds the closed former + deleted replica vars
+    assert srv.predict(data=x)[0].shape == (1, 3)
     srv.stop()
 
 
@@ -282,6 +311,48 @@ def test_batch_former_full_batch_dispatches_immediately():
     f.close()
 
 
+# --- lock-order regression ---------------------------------------------------
+
+def test_no_deadlock_polling_metrics_during_deadline_expiry():
+    # ABBA regression: metrics.get() reads the queue-depth gauge (former's
+    # _cond) and the former's expiry path calls record_error (metrics
+    # _lock). Nested either way under load, the old code deadlocked; now
+    # neither side holds its own lock while taking the other's.
+    from mxnet_tpu.serving.batcher import BatchFormer, Request
+    from mxnet_tpu.serving.metrics import ServingMetrics
+
+    m = ServingMetrics()
+    f = BatchFormer(max_batch=8, max_delay_ms=0.5, queue_depth=1024,
+                    error_hook=m.record_error)
+    m._queue_depth_fn = f.depth
+    stop = threading.Event()
+
+    def poll():
+        while not stop.is_set():
+            m.get()
+
+    def drain():
+        while f.next_batch() is not None:
+            pass
+
+    poller = threading.Thread(target=poll, daemon=True)
+    drainer = threading.Thread(target=drain, daemon=True)
+    poller.start()
+    drainer.start()
+    for _ in range(2000):  # every request pre-expired -> pure failure path
+        try:
+            f.submit(Request({}, 1, time.monotonic()))
+        except ServingError:
+            time.sleep(0.001)  # queue_full: let the drainer catch up
+    f.close()
+    drainer.join(15.0)
+    assert not drainer.is_alive(), "former loop deadlocked against metrics"
+    stop.set()
+    poller.join(5.0)
+    assert not poller.is_alive(), "metrics poll deadlocked against former"
+    assert m.error_counts().get("deadline_exceeded", 0) > 0
+
+
 # --- metrics / callback surface ---------------------------------------------
 
 def test_metrics_and_batch_end_callback():
@@ -308,3 +379,22 @@ def test_metrics_and_batch_end_callback():
     assert nv["qps"] > 0 and nv["latency_ms_p50"] > 0
     srv.metrics.reset()
     assert dict(srv.metrics.get_name_value())["completed"] == 0
+
+
+def test_raising_batch_end_callback_is_not_a_dispatch_error():
+    # all requests in the batch completed; a buggy user callback must be
+    # logged and swallowed, not recorded as a dispatch failure
+    def bad_cb(param):
+        raise RuntimeError("user callback bug")
+
+    sym = _mlp_symbol()
+    params = _mlp_params(sym)
+    cfg = ServingConfig(buckets=(1,), max_delay_ms=1.0, queue_depth=16,
+                        timeout_ms=5000.0, replicas=1)
+    srv = serving.InferenceServer(sym, params, {"data": (10,)}, config=cfg,
+                                  batch_end_callback=bad_cb)
+    x = np.zeros((1, 10), np.float32)
+    with srv:
+        assert srv.predict(data=x)[0].shape == (1, 3)
+        assert srv.predict(data=x)[0].shape == (1, 3)  # keeps serving
+    assert srv.metrics.error_counts() == {}
