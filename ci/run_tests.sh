@@ -54,4 +54,22 @@ assert mx.libinfo.find_lib_path()
 print("import OK; ops:", len(mx.ops.registry.OP_REGISTRY))
 EOF
 
+echo "== stage 7: static analysis (lock-order / engine-discipline / trace-purity) =="
+# Pure-AST gate, independent of the pytest tiers: the shipped tree must
+# produce no findings beyond ci/analysis_baseline.json (each baselined
+# entry carries a written justification). Fails on ANY new finding.
+JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis --fail-on-new
+# Self-check: the known-bad fixtures must trip the gate (a silently
+# lobotomized analyzer would otherwise pass CI forever).
+for bad in abba_deadlock undeclared_mutable impure_jit; do
+    if JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis \
+            --root "tests/fixtures/analysis/${bad}.py" \
+            --baseline none --fail-on-new >/dev/null 2>&1; then
+        echo "analysis self-check FAILED: ${bad}.py not flagged" >&2
+        exit 1
+    fi
+done
+JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis \
+    --root tests/fixtures/analysis/clean_locks.py --baseline none --fail-on-new
+
 echo "ALL CI STAGES PASSED"

@@ -94,4 +94,13 @@ def __getattr__(name):
         m = importlib.import_module(".torch", __name__)
         globals()["torch"] = globals()["th"] = m
         return m
+    # mx.analysis (static checkers + lock-order witness, docs/
+    # static_analysis.md): dev/CI tooling, lazy so `import mxnet_tpu`
+    # never pays for it.
+    if name == "analysis":
+        import importlib
+
+        m = importlib.import_module(".analysis", __name__)
+        globals()["analysis"] = m
+        return m
     raise AttributeError("module %r has no attribute %r" % (__name__, name))

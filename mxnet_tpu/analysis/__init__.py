@@ -1,0 +1,49 @@
+"""mxnet_tpu.analysis — static concurrency/purity checks for the package.
+
+Three pure-``ast`` checkers (no module under analysis is imported):
+
+- :mod:`.lockorder`     global lock-acquisition graph: cycles, declared-
+                        hierarchy violations, callbacks under locks
+- :mod:`.engine_lint`   push_async const/mutable-vars discipline,
+                        waitall()/drain loops used as fences
+- :mod:`.trace_purity`  impure calls and state mutation inside
+                        jit/shard_map-traced functions and pure_callback
+                        callbacks
+
+Run ``python -m mxnet_tpu.analysis --fail-on-new`` (the CI gate) or use
+:func:`run_analysis` programmatically. Findings carry stable fingerprints;
+``ci/analysis_baseline.json`` allowlists justified ones. The runtime
+complement is :class:`.witness.LockOrderWitness`.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from .core import (Finding, SourceModule, dedupe, diff_against_baseline,
+                   load_baseline, load_modules, write_baseline)
+from .lockorder import LOCK_HIERARCHY
+from .witness import LockOrderWitness
+
+CHECKERS = ("lockorder", "engine", "purity")
+
+
+def run_analysis(root: str,
+                 checks: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the selected checkers (default: all) over every ``*.py`` under
+    ``root`` and return deduped, location-sorted findings."""
+    from . import engine_lint, lockorder, trace_purity
+    checks = tuple(checks) if checks else CHECKERS
+    modules = load_modules(root)
+    findings: List[Finding] = []
+    if "lockorder" in checks:
+        findings += lockorder.check(modules)
+    if "engine" in checks:
+        findings += engine_lint.check(modules)
+    if "purity" in checks:
+        findings += trace_purity.check(modules)
+    return dedupe(findings)
+
+
+__all__ = ["Finding", "SourceModule", "LockOrderWitness", "LOCK_HIERARCHY",
+           "CHECKERS", "run_analysis", "load_modules", "load_baseline",
+           "write_baseline", "diff_against_baseline", "dedupe"]

@@ -1,0 +1,122 @@
+"""CLI for the static-analysis pass.
+
+::
+
+    python -m mxnet_tpu.analysis                      # report everything
+    python -m mxnet_tpu.analysis --fail-on-new        # the CI gate
+    python -m mxnet_tpu.analysis --update-baseline    # after justifying
+
+Environment defaults (flags win): MXNET_ANALYSIS_MODE (``report`` |
+``fail-on-new``), MXNET_ANALYSIS_BASELINE (path or ``none``),
+MXNET_ANALYSIS_CHECKS (comma list of lockorder,engine,purity),
+MXNET_ANALYSIS_ROOT (scan root). See docs/static_analysis.md.
+
+Exit codes: 0 clean (or no NEW findings in fail-on-new mode), 1 findings
+(new findings in fail-on-new mode), 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import CHECKERS, run_analysis
+from .core import diff_against_baseline, load_baseline, write_baseline
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(_PKG_ROOT), "ci", "analysis_baseline.json")
+
+
+def main(argv=None) -> int:
+    env = os.environ.get
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.analysis",
+        description="lock-order / engine-discipline / trace-purity "
+                    "static checks")
+    ap.add_argument("--root", default=env("MXNET_ANALYSIS_ROOT", _PKG_ROOT),
+                    help="directory (or single file) to scan "
+                         "[default: the mxnet_tpu package]")
+    ap.add_argument("--baseline",
+                    default=env("MXNET_ANALYSIS_BASELINE",
+                                _DEFAULT_BASELINE),
+                    help="baseline json allowlisting justified findings; "
+                         "'none' disables [default: ci/analysis_baseline"
+                         ".json]")
+    ap.add_argument("--checks",
+                    default=env("MXNET_ANALYSIS_CHECKS",
+                                ",".join(CHECKERS)),
+                    help="comma list of checkers to run [default: all]")
+    mode = env("MXNET_ANALYSIS_MODE", "report")
+    ap.add_argument("--fail-on-new", action="store_true",
+                    default=(mode == "fail-on-new"),
+                    help="exit non-zero only on findings missing from the "
+                         "baseline (the CI mode)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline with the current findings "
+                         "(existing justifications are preserved)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    checks = [c.strip() for c in args.checks.split(",") if c.strip()]
+    bad = [c for c in checks if c not in CHECKERS]
+    if bad:
+        print("unknown checker(s): %s (have: %s)"
+              % (",".join(bad), ",".join(CHECKERS)), file=sys.stderr)
+        return 2
+    if not os.path.exists(args.root):
+        print("scan root does not exist: %s" % args.root, file=sys.stderr)
+        return 2
+
+    findings = run_analysis(args.root, checks)
+    baseline = load_baseline(args.baseline)
+    new, stale = diff_against_baseline(findings, baseline)
+
+    if args.update_baseline:
+        old_just = {fp: e.get("justification", "")
+                    for fp, e in baseline.items()}
+        write_baseline(args.baseline, findings)
+        # preserve justifications already written for surviving findings
+        data = json.load(open(args.baseline))
+        for e in data["findings"]:
+            if old_just.get(e["fingerprint"]):
+                e["justification"] = old_just[e["fingerprint"]]
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print("baseline updated: %s (%d findings)"
+              % (args.baseline, len(findings)))
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [{"fingerprint": f.fingerprint, "checker": f.checker,
+                          "rule": f.rule, "path": f.path, "line": f.line,
+                          "qualname": f.qualname, "subject": f.subject,
+                          "message": f.message,
+                          "new": f.fingerprint not in baseline}
+                         for f in findings],
+            "stale_baseline": [e["fingerprint"] for e in stale],
+        }, indent=2))
+    else:
+        shown = new if args.fail_on_new else findings
+        for f in shown:
+            tag = "" if not args.fail_on_new or not baseline else " NEW"
+            print("%s%s" % (f.format(), tag))
+        for e in stale:
+            print("stale baseline entry (finding fixed — remove it): "
+                  "%s %s {%s}" % (e.get("rule"), e.get("subject"),
+                                  e.get("fingerprint")), file=sys.stderr)
+        n_base = sum(1 for f in findings if f.fingerprint in baseline)
+        print("%d finding(s): %d new, %d baselined; %d stale baseline "
+              "entr(ies)" % (len(findings), len(new), n_base, len(stale)))
+
+    if args.fail_on_new:
+        return 1 if new else 0
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,169 @@
+"""Shared infrastructure for the static-analysis pass.
+
+The pass is pure ``ast`` — no module under analysis is ever imported, so
+the analyzer can be pointed at fixture files reproducing known deadlocks
+without executing them. Each checker consumes the parsed module set and
+yields :class:`Finding` objects; findings carry a **stable fingerprint**
+(checker, rule, file, enclosing def, subject — everything except the line
+number) so a finding survives unrelated edits above it, and the checked-in
+baseline (``ci/analysis_baseline.json``) can allowlist justified existing
+findings while CI fails only on regressions.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer finding.
+
+    ``subject`` is the stable payload of the finding (lock ids, callee
+    name, impure call target, ...) — it participates in the fingerprint,
+    ``message`` and ``line`` do not.
+    """
+
+    checker: str    # "lockorder" | "engine" | "purity"
+    rule: str       # e.g. "lock-cycle", "callback-under-lock"
+    path: str       # posix path relative to the scan root
+    line: int
+    qualname: str   # "module:Class.method" of the enclosing def ("" = module)
+    subject: str
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        blob = "|".join((self.checker, self.rule, self.path,
+                         self.qualname, self.subject))
+        return hashlib.sha1(blob.encode()).hexdigest()[:16]
+
+    def format(self) -> str:
+        return "%s:%d: [%s/%s] %s  {%s}" % (
+            self.path, self.line, self.checker, self.rule, self.message,
+            self.fingerprint)
+
+
+class SourceModule:
+    """One parsed source file."""
+
+    def __init__(self, root: str, path: str):
+        self.path = path
+        self.relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        name = self.relpath[:-3] if self.relpath.endswith(".py") \
+            else self.relpath
+        parts = [p for p in name.split("/") if p != "__init__"]
+        self.modname = ".".join(parts) or os.path.basename(root)
+        with open(path, "r", encoding="utf-8") as f:
+            self.source = f.read()
+        self.tree = ast.parse(self.source, filename=path)
+
+
+_SKIP_DIRS = {"__pycache__", ".git", "node_modules", ".ipynb_checkpoints"}
+
+
+def load_modules(root: str) -> List[SourceModule]:
+    """Parse every ``*.py`` under ``root`` (files with syntax errors are
+    skipped — they cannot be analyzed and the test suite catches them)."""
+    root = os.path.abspath(root)
+    if os.path.isfile(root):
+        return [SourceModule(os.path.dirname(root), root)]
+    mods = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            try:
+                mods.append(SourceModule(root, os.path.join(dirpath, fn)))
+            except SyntaxError:
+                continue
+    return mods
+
+
+# --- small AST helpers shared by the checkers --------------------------------
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Map of local alias -> imported module/name. ``from . import engine``
+    maps ``engine -> engine``; ``import numpy as np`` maps ``np -> numpy``;
+    ``from threading import Lock`` maps ``Lock -> threading.Lock``."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                target = ("%s.%s" % (base, a.name)) if base else a.name
+                out[a.asname or a.name] = target
+    return out
+
+
+def unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return "<expr>"
+
+
+# --- baseline ----------------------------------------------------------------
+def load_baseline(path: Optional[str]) -> Dict[str, dict]:
+    """fingerprint -> baseline entry. ``None``/``"none"``/missing file
+    mean an empty baseline (every finding is new)."""
+    if not path or path == "none" or not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return {e["fingerprint"]: e for e in data.get("findings", [])}
+
+
+def write_baseline(path: str, findings: Sequence[Finding],
+                   justification: str = "TODO: justify") -> None:
+    entries = [{"fingerprint": f.fingerprint, "rule": f.rule, "path": f.path,
+                "qualname": f.qualname, "subject": f.subject,
+                "justification": justification}
+               for f in findings]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"findings": entries}, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def diff_against_baseline(findings: Sequence[Finding],
+                          baseline: Dict[str, dict]
+                          ) -> Tuple[List[Finding], List[dict]]:
+    """(new findings, stale baseline entries). Stale entries are reported
+    as warnings so the baseline shrinks as findings get fixed."""
+    fps = {f.fingerprint for f in findings}
+    new = [f for f in findings if f.fingerprint not in baseline]
+    stale = [e for fp, e in sorted(baseline.items()) if fp not in fps]
+    return new, stale
+
+
+def dedupe(findings: Iterable[Finding]) -> List[Finding]:
+    """Drop duplicate fingerprints (first occurrence wins) and order the
+    report by location."""
+    seen, out = set(), []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        if f.fingerprint in seen:
+            continue
+        seen.add(f.fingerprint)
+        out.append(f)
+    return out

@@ -1,0 +1,369 @@
+"""Trace-purity lint.
+
+A function traced by ``jax.jit``/``shard_map`` runs ONCE at trace time;
+anything impure inside it is baked into the compiled program or silently
+races with retraces — the exact bug class behind the torch callback
+flake. Rules, applied to every function that is (a) decorated with a
+jit-like decorator (incl. ``@partial(jax.jit, ...)``), or (b) passed as a
+local def/lambda to a jit-like call:
+
+- ``impure-time``             ``time.time()``/``monotonic``/``perf_counter``
+                              inside a traced fn (trace-time constant)
+- ``impure-random``           ``np.random.*`` / stdlib ``random.*`` inside
+                              a traced fn (use ``jax.random`` keys)
+- ``impure-global-mutation``  ``global`` declaration with a store inside a
+                              traced fn
+- ``impure-closure-mutation`` ``nonlocal`` rebind or subscript/attribute
+                              store to a closed-over name inside a traced
+                              fn (runs once at trace, not per step)
+- ``print-in-trace``          ``print`` in a traced fn (fires at trace
+                              time only; use ``jax.debug.print``)
+- ``callback-shared-state``   a ``jax.pure_callback`` callback (or a local
+                              helper it calls) mutates closed-over host
+                              state with no lock fence around the store —
+                              concurrent device-side replays race on it
+
+``pure_callback`` discipline is checked in *every* function, traced or
+not, because the callbacks escape into compiled code regardless of where
+they are built.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, SourceModule, dotted, import_aliases, unparse
+
+_JIT_TAILS = {"jit", "pjit", "shard_map"}
+_TIME_CALLS = {"time.time", "time.monotonic", "time.perf_counter",
+               "time.process_time", "datetime.datetime.now"}
+_STDLIB_RANDOM = {"random", "randint", "randrange", "choice", "choices",
+                  "shuffle", "uniform", "gauss", "normalvariate", "seed"}
+
+
+def _jit_like(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    """True for ``jax.jit`` / ``jit`` / ``collectives.shard_map``-style
+    references (import-alias aware: a bare name must come from jax or a
+    package module whose name ends with the tail)."""
+    d = dotted(node)
+    if d is None:
+        return False
+    tail = d.split(".")[-1]
+    if tail not in _JIT_TAILS:
+        return False
+    if "." in d:
+        return True
+    src = aliases.get(d, "")
+    return src.split(".")[0] in ("jax", "collectives") or \
+        src.endswith(".%s" % tail) or src == d
+
+
+def _decorated_traced(fn: ast.AST, aliases: Dict[str, str]) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        if _jit_like(dec, aliases):
+            return True
+        if isinstance(dec, ast.Call):
+            if _jit_like(dec.func, aliases):
+                return True
+            d = dotted(dec.func)
+            if d is not None and d.split(".")[-1] == "partial" and \
+                    dec.args and _jit_like(dec.args[0], aliases):
+                return True
+    return False
+
+
+def _fn_params(fn: ast.AST) -> Set[str]:
+    args = fn.args
+    params = {a.arg for a in args.posonlyargs + args.args + args.kwonlyargs}
+    if args.vararg:
+        params.add(args.vararg.arg)
+    if args.kwarg:
+        params.add(args.kwarg.arg)
+    return params
+
+
+def _local_names(fn: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    body = [fn.body] if isinstance(fn, ast.Lambda) else fn.body
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Assign, ast.For, ast.AnnAssign)):
+                targets = getattr(node, "targets", None) or \
+                    [getattr(node, "target")]
+                for t in targets:
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, ast.Name) and \
+                                isinstance(leaf.ctx, ast.Store):
+                            names.add(leaf.id)
+            elif isinstance(node, ast.withitem) and \
+                    isinstance(node.optional_vars, ast.Name):
+                names.add(node.optional_vars.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                names.add(node.name)
+            elif isinstance(node, ast.comprehension):
+                for leaf in ast.walk(node.target):
+                    if isinstance(leaf, ast.Name):
+                        names.add(leaf.id)
+    return names
+
+
+class _TracedFnCheck:
+    """Purity scan of one traced function body."""
+
+    def __init__(self, mod: SourceModule, aliases: Dict[str, str],
+                 qualname: str, fn: ast.AST, findings: List[Finding]):
+        self.mod = mod
+        self.aliases = aliases
+        self.qualname = qualname
+        self.fn = fn
+        self.findings = findings
+
+    def _emit(self, rule: str, line: int, subject: str, message: str):
+        self.findings.append(Finding(
+            "purity", rule, self.mod.relpath, line, self.qualname,
+            subject, message))
+
+    def run(self):
+        fn = self.fn
+        params = _fn_params(fn) if not isinstance(fn, ast.Lambda) \
+            else {a.arg for a in fn.args.args}
+        local = _local_names(fn)
+        nonlocals: Set[str] = set()
+        globals_: Set[str] = set()
+        body = [fn.body] if isinstance(fn, ast.Lambda) else fn.body
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Global):
+                    globals_.update(node.names)
+                    self._emit(
+                        "impure-global-mutation", node.lineno,
+                        ",".join(node.names),
+                        "traced fn declares global %s — the mutation "
+                        "happens at trace time, not per step"
+                        % ",".join(node.names))
+                elif isinstance(node, ast.Nonlocal):
+                    nonlocals.update(node.names)
+                    self._emit(
+                        "impure-closure-mutation", node.lineno,
+                        ",".join(node.names),
+                        "traced fn rebinds nonlocal %s — the mutation "
+                        "happens at trace time, not per step"
+                        % ",".join(node.names))
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = getattr(node, "targets", None) or \
+                        [node.target]
+                    for t in targets:
+                        base = _subscript_store_base(t)
+                        if base is not None and base not in params and \
+                                base not in local and base != "self":
+                            self._emit(
+                                "impure-closure-mutation", node.lineno,
+                                base,
+                                "traced fn stores into closed-over '%s' — "
+                                "runs once at trace time and races with "
+                                "retraces" % base)
+                elif isinstance(node, ast.Call):
+                    self._check_call(node)
+
+    def _check_call(self, call: ast.Call):
+        d = dotted(call.func)
+        if d is None:
+            return
+        if d == "print":
+            self._emit("print-in-trace", call.lineno, d,
+                       "print() in a traced fn fires at trace time only — "
+                       "use jax.debug.print")
+            return
+        if d in _TIME_CALLS:
+            self._emit("impure-time", call.lineno, d,
+                       "%s() in a traced fn is a trace-time constant — "
+                       "pass time in as an argument" % d)
+            return
+        parts = d.split(".")
+        if len(parts) >= 3 and parts[-2] == "random" and \
+                self.aliases.get(parts[0], parts[0]) == "numpy":
+            self._emit("impure-random", call.lineno, d,
+                       "%s in a traced fn draws host entropy at trace "
+                       "time — use jax.random with an explicit key" % d)
+            return
+        if len(parts) == 2 and parts[0] == "random" and \
+                parts[1] in _STDLIB_RANDOM and \
+                self.aliases.get("random", "random") == "random":
+            self._emit("impure-random", call.lineno, d,
+                       "stdlib %s in a traced fn draws host entropy at "
+                       "trace time — use jax.random" % d)
+
+
+def _subscript_store_base(t: ast.AST) -> Optional[str]:
+    seen = False
+    while isinstance(t, (ast.Subscript, ast.Attribute, ast.Starred)):
+        seen = True
+        t = t.value
+    if seen and isinstance(t, ast.Name):
+        return t.id
+    return None
+
+
+# --- pure_callback shared-state discipline -----------------------------------
+def _store_is_fenced(store: ast.AST, enclosing: ast.AST) -> bool:
+    """True if ``store`` sits inside a ``with <lock>:`` block within
+    ``enclosing`` (any non-call context manager counts as the fence —
+    resolution of the actual lock object is the lockorder checker's job)."""
+    for node in ast.walk(enclosing):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            fenced = any(not isinstance(i.context_expr, ast.Call) or
+                         dotted(i.context_expr.func) is not None
+                         for i in node.items)
+            if fenced:
+                for sub in ast.walk(node):
+                    if sub is store:
+                        return True
+    return False
+
+
+def _check_pure_callbacks(mod: SourceModule, aliases: Dict[str, str],
+                          qualname: str, fn: ast.AST,
+                          findings: List[Finding]):
+    local_defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                node is not fn:
+            local_defs[node.name] = node
+    cb_roots: List[ast.AST] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d is None or d.split(".")[-1] not in ("pure_callback",
+                                                     "io_callback"):
+                continue
+            if node.args:
+                cb = node.args[0]
+                if isinstance(cb, ast.Lambda):
+                    cb_roots.append(cb)
+                elif isinstance(cb, ast.Name) and cb.id in local_defs:
+                    cb_roots.append(local_defs[cb.id])
+    if not cb_roots:
+        return
+    # one level of transitive closure over sibling local defs: the callback
+    # may delegate its state touch to a helper (get_op-style memoization)
+    reach: List[ast.AST] = list(cb_roots)
+    for root in cb_roots:
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in local_defs and \
+                    local_defs[node.func.id] not in reach:
+                reach.append(local_defs[node.func.id])
+    outer_params = _fn_params(fn) if not isinstance(fn, ast.Lambda) else set()
+    for cb in reach:
+        params = _fn_params(cb) if not isinstance(cb, ast.Lambda) \
+            else {a.arg for a in cb.args.args}
+        local = _local_names(cb)
+        body = [cb.body] if isinstance(cb, ast.Lambda) else cb.body
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                    continue
+                targets = getattr(node, "targets", None) or [node.target]
+                for t in targets:
+                    base = _subscript_store_base(t)
+                    if base is None or base in params or base in local \
+                            or base == "self":
+                        continue
+                    if _store_is_fenced(node, cb):
+                        continue
+                    cb_name = getattr(cb, "name", "<lambda>")
+                    findings.append(Finding(
+                        "purity", "callback-shared-state", mod.relpath,
+                        node.lineno, qualname,
+                        "%s:%s" % (cb_name, base),
+                        "pure_callback callback %s mutates shared host "
+                        "state '%s' with no lock fence — concurrent "
+                        "device-side replays race on it (the torch-flake "
+                        "bug class); guard the store with a lock"
+                        % (cb_name, base)))
+                    _ = outer_params  # kept for future param-aware rules
+
+
+def check(modules: Sequence[SourceModule]) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in modules:
+        aliases = import_aliases(m.tree)
+        # enumerate every def with a qualname; find traced ones. The
+        # pure_callback scan walks a whole top-level def's subtree (its
+        # callbacks may be declared at any nesting depth), so it runs only
+        # for depth-0 defs; the jit-call-arg scan stops at def boundaries,
+        # so it runs at every depth without double-reporting.
+        def visit(body, prefix, top):
+            for node in body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = "%s:%s" % (m.modname, prefix + node.name)
+                    if _decorated_traced(node, aliases):
+                        _TracedFnCheck(m, aliases, q, node, findings).run()
+                    if top:
+                        _check_pure_callbacks(m, aliases, q, node, findings)
+                    _scan_jit_call_args(m, aliases, q, node.body, node.body,
+                                        findings)
+                    visit(node.body, prefix + node.name + ".", False)
+                elif isinstance(node, ast.ClassDef):
+                    visit(node.body, prefix + node.name + ".", top)
+        visit(m.tree.body, "", True)
+        # module scope: defs come from the whole module body, but only
+        # top-level statements are searched for jit(f) calls (calls inside
+        # defs were handled above under their own qualname)
+        top = [s for s in m.tree.body
+               if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef))]
+        _scan_jit_call_args(m, aliases, "%s:" % m.modname, m.tree.body,
+                            top, findings)
+    return findings
+
+
+def _walk_stop_at_defs(root: ast.AST):
+    """Yield nodes of ``root``'s subtree without descending into nested
+    function/class definitions (their bodies are scanned under their own
+    qualname)."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        if node is not root and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _scan_jit_call_args(mod: SourceModule, aliases: Dict[str, str],
+                        qualname: str, defs_body, search_stmts,
+                        findings: List[Finding]):
+    """Find ``jit(f)``/``shard_map(f, ...)`` calls in ``search_stmts`` and
+    purity-check ``f`` when it resolves to a local def or lambda declared
+    in ``defs_body``."""
+    local_defs: Dict[str, ast.AST] = {}
+    for node in defs_body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            local_defs[node.name] = node
+        elif isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Lambda) and \
+                len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            local_defs[node.targets[0].id] = node.value
+    checked: Set[int] = set()
+    for stmt in search_stmts:
+        for node in _walk_stop_at_defs(stmt):
+            if not (isinstance(node, ast.Call)
+                    and _jit_like(node.func, aliases) and node.args):
+                continue
+            target = node.args[0]
+            fn: Optional[ast.AST] = None
+            if isinstance(target, ast.Lambda):
+                fn = target
+            elif isinstance(target, ast.Name) and target.id in local_defs:
+                fn = local_defs[target.id]
+            if fn is None or id(fn) in checked:
+                continue
+            checked.add(id(fn))
+            name = getattr(fn, "name", "<lambda>")
+            q = qualname if qualname.endswith(name) \
+                else "%s>%s" % (qualname, name)
+            _TracedFnCheck(mod, aliases, q, fn, findings).run()

@@ -316,6 +316,53 @@ def wait_for_all():
     _raise_pending_file_error()
 
 
+class Fence:
+    """Handle returned by :func:`fence` — a pushed barrier op.
+
+    ``wait()`` blocks until every op enqueued BEFORE the fence on the
+    fenced vars has fully completed — including async ops, whose
+    completion is their host ``on_complete`` callback firing. That is the
+    happens-before edge ``nd.waitall()`` does NOT provide (it drains the
+    device queue; host callbacks may still be in flight) and that a
+    per-var ``wait_for_var`` loop provides only one var at a time.
+    """
+
+    def __init__(self, event: threading.Event, n_vars: int):
+        self._event = event
+        self.n_vars = n_vars
+
+    def done(self) -> bool:
+        """True once the barrier op has run (non-blocking probe)."""
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> "Fence":
+        """Block for the barrier; raises MXNetError on timeout."""
+        if not self._event.wait(timeout):
+            raise MXNetError(
+                "engine fence over %d var(s) not reached after %.3fs"
+                % (self.n_vars, timeout))
+        return self
+
+
+def fence(vars: Sequence[int], priority: int = 0,
+          name: str = "fence") -> Fence:
+    """Push a barrier op ordered after everything enqueued on ``vars``.
+
+    The barrier reads every var (``const_vars``), so the engine schedules
+    it only once all prior writers — sync or async — have completed.
+    Returns immediately with a :class:`Fence`; call ``.wait()`` for the
+    blocking edge, or poll ``.done()`` to overlap host work::
+
+        f = engine.fence([var_a, var_b], name="ckpt_fence")
+        ...                      # overlapped host work
+        f.wait()                 # ops on var_a/var_b happened-before here
+    """
+    ev = threading.Event()
+    vs = list(vars)
+    get().push(ev.set, const_vars=vs, priority=priority, name=name)
+    return Fence(ev, len(vs))
+
+
 # --- file-write routing ------------------------------------------------------
 # Checkpoint/state blob writes ride the engine with one write-var per file
 # path (the reference's NDArray save-through-engine: every host mutation of

@@ -340,8 +340,11 @@ class PSKVStore(KVStore):
             self._engine.get().push(lambda f=do_pull: self._safe_rpc(f),
                                     mutable_vars=[self._key_var(k)],
                                     priority=priority, name="ps_pull")
-        for k in keys:
-            self._engine.get().wait_for_var(self._key_var(k))
+        # one pushed barrier over every pulled key: unlike a per-key
+        # wait_for_var loop it is a single engine op and orders after the
+        # RPCs' host-side completion as well
+        self._engine.fence([self._key_var(k) for k in keys],
+                           name="ps_pull_fence").wait()
         self._raise_pending()
         # a completed pull means this worker holds current server weights:
         # recovery is over, future barriers are real again
@@ -365,8 +368,8 @@ class PSKVStore(KVStore):
     def barrier(self):
         # flush every queued push/pull first: a barrier with RPCs still in
         # the engine queue would not be a barrier
-        for v in self._key_vars.values():
-            self._engine.get().wait_for_var(v)
+        self._engine.fence(list(self._key_vars.values()),
+                           name="ps_barrier_fence").wait()
         self._raise_pending()
         if self._recovery:
             # startup barrier skip (reference is_recovery,
@@ -383,8 +386,8 @@ class PSKVStore(KVStore):
         self._recovery = False
 
     def stop_server(self):
-        for v in self._key_vars.values():
-            self._engine.get().wait_for_var(v)
+        self._engine.fence(list(self._key_vars.values()),
+                           name="ps_stop_fence").wait()
         self._raise_pending()
         self._hb_stop.set()
         if self._rank == 0:

@@ -29,6 +29,7 @@ User API (identical shape to the reference):
 """
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -197,13 +198,19 @@ def apply_custom(attrs: Dict[str, Any], inputs, aux, is_train: bool):
     aux_specs = _result_specs([a.shape for a in aux], [a.dtype for a in aux])
 
     op_holder: List[Optional[CustomOp]] = [None]
+    op_lock = threading.Lock()
 
     def get_op():
-        if op_holder[0] is None:
-            op_holder[0] = prop.create_operator(
-                None, [list(s) for s in in_shapes], in_types
-            )
-        return op_holder[0]
+        # fwd_cb and bwd_cb share this memoization from pure_callback, and
+        # the runtime may replay them concurrently — without the lock two
+        # replays can race create_operator and train two distinct stateful
+        # op instances
+        with op_lock:
+            if op_holder[0] is None:
+                op_holder[0] = prop.create_operator(
+                    None, [list(s) for s in in_shapes], in_types
+                )
+            return op_holder[0]
 
     n_in = len(inputs)
 

@@ -42,6 +42,8 @@ class ServingMetrics:
     #: ring-buffer size for latency percentiles (recent-window, not
     #: whole-lifetime, so a warmup spike ages out)
     LATENCY_WINDOW = 4096
+    #: per-bucket ring-buffer size (smaller: there is one per bucket)
+    BUCKET_LATENCY_WINDOW = 1024
 
     def __init__(self, queue_depth_fn: Optional[Callable[[], int]] = None,
                  cache_stats_fn: Optional[Callable[[], Dict]] = None):
@@ -60,6 +62,11 @@ class ServingMetrics:
             self.sum_bucket_rows = 0
             self.errors: Dict[str, int] = {}
             self._lat: deque = deque(maxlen=self.LATENCY_WINDOW)
+            # per-bucket latency windows + batch counts: the SLO seam —
+            # tail latency is a property of a bucket (its compiled shape),
+            # not of the mixed traffic aggregate
+            self._bucket_lat: Dict[int, deque] = {}
+            self._bucket_batches: Dict[int, int] = {}
 
     # --- recorders (called by the server/batcher) -------------------------
     def record_submit(self, rows: int = 1):
@@ -78,6 +85,13 @@ class ServingMetrics:
             self.sum_bucket_rows += bucket
             self.n_completed += len(latencies_ms)
             self._lat.extend(latencies_ms)
+            blat = self._bucket_lat.get(bucket)
+            if blat is None:
+                blat = self._bucket_lat[bucket] = deque(
+                    maxlen=self.BUCKET_LATENCY_WINDOW)
+            blat.extend(latencies_ms)
+            self._bucket_batches[bucket] = \
+                self._bucket_batches.get(bucket, 0) + 1
 
     # --- metric.py-style surface ------------------------------------------
     def get(self):
@@ -105,6 +119,15 @@ class ServingMetrics:
                 self.n_submitted, self.n_completed, self.n_batches,
                 sum(self.errors.values()),
             ]
+            # per-bucket gauges, stable order: bucket<k>_latency_ms_p50/
+            # p95/p99 + bucket<k>_batches — the dashboard's SLO series
+            for k in sorted(self._bucket_lat):
+                blat = sorted(self._bucket_lat[k])
+                for q in (50, 95, 99):
+                    names.append("bucket%d_latency_ms_p%d" % (k, q))
+                    values.append(_percentile(blat, q))
+                names.append("bucket%d_batches" % k)
+                values.append(self._bucket_batches.get(k, 0))
         if self._cache_stats_fn:
             stats = self._cache_stats_fn()
             for k in ("compile_cache_hits", "compile_cache_misses",
@@ -117,6 +140,14 @@ class ServingMetrics:
     def get_name_value(self):
         names, values = self.get()
         return list(zip(names, values))
+
+    def bucket_latency(self, bucket: int, q: float = 99.0) -> float:
+        """The bucket's recent-window latency percentile (ms) — the SLO
+        probe: alert when ``bucket_latency(k, 99) > budget_ms``. NaN until
+        the bucket has dispatched."""
+        with self._lock:
+            blat = self._bucket_lat.get(bucket)
+            return _percentile(sorted(blat), q) if blat else float("nan")
 
     def error_counts(self) -> Dict[str, int]:
         with self._lock:

@@ -239,22 +239,27 @@ class _TorchCriterionOp(_operator.CustomOp):
         self.criterion = criterion
 
     def forward(self, is_train, req, in_data, out_data, aux):
-        x = _to_torch(np.asarray(in_data[0]), False)
-        t = _to_torch(np.asarray(in_data[1]), False)
-        with _th.no_grad():
-            loss = self.criterion(x, t)
-        self.assign(out_data[0],
-                    req[0] if isinstance(req, (list, tuple)) else req,
-                    np.asarray([float(loss)], np.float32))
+        # under _TH_LOCK like the module/function ops: torch callbacks may
+        # be replayed from concurrent engine workers and libtorch autograd
+        # state is not re-entrant from our side
+        with _TH_LOCK:
+            x = _to_torch(np.asarray(in_data[0]), False)
+            t = _to_torch(np.asarray(in_data[1]), False)
+            with _th.no_grad():
+                loss = self.criterion(x, t)
+            self.assign(out_data[0],
+                        req[0] if isinstance(req, (list, tuple)) else req,
+                        np.asarray([float(loss)], np.float32))
 
     def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
-        x = _to_torch(np.asarray(in_data[0]), True)
-        t = _to_torch(np.asarray(in_data[1]), False)
-        loss = self.criterion(x, t)
-        loss.backward()
-        r0 = req[0] if isinstance(req, (list, tuple)) else req
-        self.assign(in_grad[0], r0, x.grad.numpy())
-        if len(in_grad) > 1:
-            r1 = req[1] if isinstance(req, (list, tuple)) else req
-            self.assign(in_grad[1], r1,
-                        np.zeros_like(np.asarray(in_data[1])))
+        with _TH_LOCK:
+            x = _to_torch(np.asarray(in_data[0]), True)
+            t = _to_torch(np.asarray(in_data[1]), False)
+            loss = self.criterion(x, t)
+            loss.backward()
+            r0 = req[0] if isinstance(req, (list, tuple)) else req
+            self.assign(in_grad[0], r0, x.grad.numpy())
+            if len(in_grad) > 1:
+                r1 = req[1] if isinstance(req, (list, tuple)) else req
+                self.assign(in_grad[1], r1,
+                            np.zeros_like(np.asarray(in_data[1])))

@@ -1,0 +1,61 @@
+"""Fixture: the PRE-FIX PR 2 serving deadlock, both shapes.
+
+Never imported — the analyzer parses it. ``Metrics.get`` holds ``_lock``
+and calls into the former (which takes ``_cond``); ``Former.next_batch``
+holds ``_cond`` and calls back into metrics (which takes ``_lock``) — the
+ABBA cycle — and also invokes the user error hook (via ``_fail``) while
+``_cond`` is held, the callback-under-lock shape.
+"""
+import threading
+
+
+class Metrics:
+    def __init__(self, former: "Former"):
+        self._lock = threading.Lock()
+        self._former = former
+        self.errors = {}
+
+    def get(self):
+        with self._lock:
+            depth = self._former.depth()      # takes _cond under _lock
+            return dict(self.errors, queue_depth=depth)
+
+    def record_error(self, code):
+        with self._lock:
+            self.errors[code] = self.errors.get(code, 0) + 1
+
+
+class Former:
+    def __init__(self, metrics: Metrics, error_hook=None):
+        self._cond = threading.Condition()
+        self.metrics = metrics
+        self._error_hook = error_hook
+        self._q = []
+
+    def depth(self):
+        with self._cond:
+            return len(self._q)
+
+    def submit(self, req):
+        with self._cond:
+            self._q.append(req)
+            self._cond.notify()
+
+    def _fail(self, req, code):
+        req.set_error(code)
+        if self._error_hook is not None:
+            self._error_hook(code)
+
+    def next_batch(self):
+        with self._cond:
+            while not self._q:
+                self._cond.wait()
+            req = self._q.pop(0)
+            if req.expired():
+                # BOTH bugs live here: record_error takes _lock under
+                # _cond (ABBA with Metrics.get), and _fail fires the user
+                # hook while _cond is held
+                self.metrics.record_error("deadline_exceeded")
+                self._fail(req, "deadline_exceeded")
+                return None
+            return req

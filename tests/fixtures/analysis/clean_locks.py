@@ -1,0 +1,44 @@
+"""Fixture: correct concurrency — the analyzer must report NOTHING here.
+
+One-directional nesting (outer -> inner, acyclic), callbacks invoked only
+after releasing, and a lock group accessed one member at a time.
+"""
+import threading
+
+
+class Outer:
+    def __init__(self, inner: "Inner", hook=None):
+        self._lock = threading.Lock()
+        self._inner = inner
+        self._hook = hook
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+            total = self._inner.add(1)   # consistent outer -> inner order
+        if self._hook is not None:
+            self._hook(total)            # callback OUTSIDE the lock
+        return total
+
+
+class Inner:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+
+    def add(self, k):
+        with self._lock:
+            self._total += k
+            return self._total
+
+
+class Sharded:
+    def __init__(self, n):
+        self._locks = [threading.Lock() for _ in range(n)]
+        self._vals = [0] * n
+
+    def incr(self, i):
+        with self._locks[i]:             # one member at a time: fine
+            self._vals[i] += 1
+            return self._vals[i]

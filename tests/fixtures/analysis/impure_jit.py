@@ -1,0 +1,61 @@
+"""Fixture: trace-purity violations. Never imported — parsed only.
+
+``impure_step`` is jitted and calls host time/entropy, mutates a
+closed-over dict, and prints; ``make_step`` passes an impure fn to
+``jax.jit`` by name; ``unfenced_callback`` shares mutable host state
+between pure_callback replays without a lock. ``clean_step`` uses
+``jax.random`` with an explicit key and must NOT be flagged.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_stats = {}
+_step_count = 0
+
+
+@jax.jit
+def impure_step(params, grads):
+    t0 = time.time()                       # trace-time constant
+    noise = np.random.rand(*grads.shape)   # host entropy at trace time
+    _stats["last"] = t0                    # closed-over mutation
+    print("step!")                         # fires at trace only
+    return params - 0.1 * (grads + noise)
+
+
+def make_step(lr):
+    def step(params, grads):
+        global _step_count
+        _step_count += 1                   # global mutation in trace
+        return params - lr * grads
+
+    return jax.jit(step)
+
+
+def unfenced_callback(xs):
+    holder = [None]
+
+    def get_state():
+        if holder[0] is None:
+            holder[0] = np.zeros(4)        # unfenced shared-state store
+        return holder[0]
+
+    def cb(a):
+        return np.asarray(a) + get_state()
+
+    return jax.pure_callback(
+        cb, jax.ShapeDtypeStruct(xs.shape, xs.dtype), xs)
+
+
+def clean_step(lr):
+    def step(params, grads, key):
+        noise = jax.random.normal(key, grads.shape)
+        return params - lr * (grads + 0.01 * noise), jax.random.split(key)
+
+    return jax.jit(step)
+
+
+def clean_norm(x):
+    return jnp.sqrt(jnp.sum(x * x))

@@ -1,0 +1,47 @@
+"""Fixture: engine-discipline violations. Never imported — parsed only.
+
+``bad_gather`` pushes a closure that mutates ``results`` without
+declaring it; ``bad_fence`` drains with ``waitall()`` between dependent
+ops; ``bad_naked_push`` declares no vars at all. ``good_gather`` is the
+clean counterpart and must NOT be flagged.
+"""
+from mxnet_tpu import engine
+from mxnet_tpu import ndarray as nd
+
+
+def bad_gather(arrays):
+    results = {}
+    out_var = engine.new_variable()
+
+    def fetch(i, a):
+        results[i] = a.sum()          # mutates undeclared host state
+
+    for i, a in enumerate(arrays):
+        engine.push(lambda i=i, a=a: fetch(i, a), const_vars=[out_var])
+    return results
+
+
+def bad_fence(write_ckpt, read_ckpt):
+    v = engine.new_variable()
+    engine.push_async(lambda done: write_ckpt(done), mutable_vars=[v])
+    nd.waitall()                      # NOT a happens-before edge
+    return read_ckpt()
+
+
+def bad_naked_push(fn):
+    engine.push_async(fn)             # no const_vars, no mutable_vars
+
+
+def good_gather(arrays):
+    results = {}
+    res_var = engine.new_variable()
+
+    def fetch(i, a):
+        results[i] = a.sum()
+
+    for i, a in enumerate(arrays):
+        engine.push(lambda i=i, a=a: fetch(i, a),
+                    mutable_vars=[res_var], name="gather")
+    f = engine.fence([res_var], name="gather_fence")
+    f.wait()
+    return results

@@ -255,3 +255,42 @@ def test_prefetch_rides_engine():
     assert got2 == got
     it.close()
     engine.wait_for_all()
+
+
+# --- engine.fence: a real happens-before barrier -----------------------------
+def test_fence_orders_after_async_op_and_host_callbacks():
+    """fence(vars).wait() returns only after every prior op on those vars
+    has FULLY completed — including async ops whose work runs on a helper
+    thread and only finishes at on_complete (the hole nd.waitall() cannot
+    close, see engine.Fence docstring)."""
+    va = eng.new_variable()
+    vb = eng.new_variable()
+    events = []
+
+    def slow_async(on_complete):
+        def run():
+            time.sleep(0.05)
+            events.append("a")
+            on_complete()
+        threading.Thread(target=run, daemon=True).start()
+
+    eng.push_async(slow_async, mutable_vars=[va])
+    eng.push(lambda: events.append("b"), mutable_vars=[vb])
+    f = eng.fence([va, vb], name="test_fence")
+    assert f.wait(timeout=10.0) is f          # chains
+    assert sorted(events) == ["a", "b"]       # both strictly before wait()
+    assert f.done()
+    eng.wait_for_all()
+
+
+def test_fence_done_probe_and_timeout():
+    from mxnet_tpu.base import MXNetError
+
+    # a fence whose event never fires: done() is a non-blocking probe and
+    # wait(timeout) raises rather than hanging
+    f = eng.Fence(threading.Event(), 3)
+    assert not f.done()
+    with pytest.raises(MXNetError, match="3 var"):
+        f.wait(timeout=0.05)
+    # an empty fence completes as soon as the queue reaches it
+    assert eng.fence([]).wait(timeout=10.0).done()

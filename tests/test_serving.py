@@ -398,3 +398,31 @@ def test_raising_batch_end_callback_is_not_a_dispatch_error():
         assert srv.predict(data=x)[0].shape == (1, 3)
         assert srv.predict(data=x)[0].shape == (1, 3)  # keeps serving
     assert srv.metrics.error_counts() == {}
+
+
+def test_per_bucket_latency_gauges():
+    """ISSUE 3 satellite (f): tail latency is a property of a bucket (its
+    compiled shape), so ServingMetrics exports bucket<k>_latency_ms_p*/
+    bucket<k>_batches gauges on the same get()/get_name_value() path."""
+    import math
+
+    from mxnet_tpu.serving.metrics import ServingMetrics
+
+    m = ServingMetrics()
+    m.record_batch(rows=2, bucket=2, latencies_ms=[1.0, 3.0])
+    m.record_batch(rows=4, bucket=4, latencies_ms=[10.0] * 4)
+    m.record_batch(rows=3, bucket=4, latencies_ms=[30.0] * 3)
+    nv = dict(m.get_name_value())
+    for k in (2, 4):
+        for q in (50, 95, 99):
+            assert "bucket%d_latency_ms_p%d" % (k, q) in nv, (k, q)
+    assert nv["bucket2_batches"] == 1
+    assert nv["bucket4_batches"] == 2
+    # bucket windows are independent of the aggregate window
+    assert nv["bucket2_latency_ms_p99"] == 3.0
+    assert nv["bucket4_latency_ms_p99"] == 30.0
+    # the SLO probe
+    assert m.bucket_latency(4, q=99) == 30.0
+    assert math.isnan(m.bucket_latency(8))   # never dispatched
+    m.reset()
+    assert "bucket2_batches" not in dict(m.get_name_value())
