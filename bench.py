@@ -407,10 +407,7 @@ def run_serving_config():
             except serving.ServingError as e:
                 errors.append(e.code)
 
-    with srv:
-        # warm the compile cache outside the timed window so the record
-        # measures steady-state serving, not XLA compilation
-        srv.predict(data=np.zeros((1, in_dim), np.float32))
+    def burst():
         srv.metrics.reset()
         t0 = time.perf_counter()
         threads = [threading.Thread(target=client, args=(i,))
@@ -420,7 +417,31 @@ def run_serving_config():
         for t in threads:
             t.join()
         wall = time.perf_counter() - t0
-    m = dict(zip(*srv.get_metrics()))
+        return dict(zip(*srv.get_metrics())), wall
+
+    from mxnet_tpu import telemetry
+
+    with srv:
+        # warm the compile cache outside the timed window so the record
+        # measures steady-state serving, not XLA compilation
+        srv.predict(data=np.zeros((1, in_dim), np.float32))
+        # A/B the instrumentation cost: burst with spans off (the default
+        # production configuration — the headline record), then the same
+        # burst with serving+engine spans recording
+        telemetry.disable_spans()
+        m, wall = burst()
+        telemetry.enable_spans("serving,engine")
+        m_on, wall_on = burst()
+        telemetry.disable_spans()
+        telemetry.reset()
+    qps_off = m["completed"] / wall
+    qps_on = m_on["completed"] / wall_on if wall_on else float("nan")
+    telemetry_rec = {
+        "spans_off_qps": round(qps_off, 1),
+        "spans_on_qps": round(qps_on, 1),
+        "spans_on_overhead_pct": round(100.0 * (qps_off - qps_on)
+                                       / qps_off, 2) if qps_off else None,
+    }
     cache = srv.cache_stats()
     total = cache["hits"] + cache["misses"]
     return {
@@ -440,6 +461,7 @@ def run_serving_config():
         "buckets": list(cfg.buckets),
         "max_delay_ms": cfg.max_delay_ms,
         "client_errors": len(errors),
+        "telemetry": telemetry_rec,
         "model": "MLP %d-%d-%d softmax, 1-row requests"
                  % (in_dim, hidden, classes),
     }

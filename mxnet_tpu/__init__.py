@@ -25,6 +25,7 @@ from . import executor
 from .executor import Executor
 from . import predict
 from . import serving
+from . import telemetry
 from . import autograd   # transitive deps of the executor surface:
 from . import random     # bound unconditionally for consistency
 from .random import seed

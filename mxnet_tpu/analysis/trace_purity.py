@@ -18,6 +18,10 @@ local def/lambda to a jit-like call:
                               fn (runs once at trace, not per step)
 - ``print-in-trace``          ``print`` in a traced fn (fires at trace
                               time only; use ``jax.debug.print``)
+- ``telemetry-in-jit``        ``telemetry.span``/``instant``/registry
+                              mutations inside a traced fn — the span
+                              brackets trace time (once), not execution;
+                              instrument the host call site instead
 - ``callback-shared-state``   a ``jax.pure_callback`` callback (or a local
                               helper it calls) mutates closed-over host
                               state with no lock fence around the store —
@@ -180,6 +184,16 @@ class _TracedFnCheck:
                        "pass time in as an argument" % d)
             return
         parts = d.split(".")
+        root = self.aliases.get(parts[0], parts[0])
+        if parts[0] != "self" and len(parts) >= 2 and \
+                "telemetry" in root.split("."):
+            self._emit(
+                "telemetry-in-jit", call.lineno, d,
+                "%s in a traced fn runs at trace time only — the span/"
+                "metric brackets tracing, not execution, and silently "
+                "stops firing once the trace is cached; keep "
+                "instrumentation outside jit/shard_map" % d)
+            return
         if len(parts) >= 3 and parts[-2] == "random" and \
                 self.aliases.get(parts[0], parts[0]) == "numpy":
             self._emit("impure-random", call.lineno, d,

@@ -33,6 +33,7 @@ import threading
 import traceback
 from typing import Callable, Dict, Optional, Sequence
 
+from . import telemetry as _telemetry
 from .base import MXNetError
 
 _OPR_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p)
@@ -100,20 +101,39 @@ class NativeEngine:
     def _dispatch(self, param, on_complete):
         key = int(param)
         with self._pending_lock:
-            fn, is_async = self._pending.pop(key)
+            fn, is_async, name, t_q, const_vars, mutable_vars = \
+                self._pending.pop(key)
+        # t_q was stamped at push time iff the engine span domain was on;
+        # queue wait = dispatch time - push time. Worker thread identity
+        # rides for free on the per-thread telemetry buffer; an async op's
+        # end() records the completing thread as end_tid.
+        span_args = None
+        if t_q and _telemetry.enabled("engine"):
+            span_args = {"queue_us": (_telemetry.clock_ns() - t_q) // 1000,
+                         "const_vars": list(const_vars),
+                         "mutable_vars": list(mutable_vars)}
+        tok = None
         try:
             if is_async:
                 h = ctypes.c_void_p(on_complete)
+                if span_args is not None:
+                    tok = _telemetry.begin(name, domain="engine", **span_args)
 
-                def complete(_h=h):
+                def complete(_h=h, _tok=tok):
+                    _telemetry.end(_tok)
                     self._lib.mxe_opr_complete(self._h, _h)
 
                 fn(complete)
             else:
-                fn()
+                if span_args is not None:
+                    with _telemetry.span(name, domain="engine", **span_args):
+                        fn()
+                else:
+                    fn()
         except Exception:  # never let an exception cross the C boundary
             traceback.print_exc()
             if is_async:
+                _telemetry.end(tok, error=True)
                 self._lib.mxe_opr_complete(self._h, ctypes.c_void_p(on_complete))
 
     def new_variable(self) -> int:
@@ -124,10 +144,12 @@ class NativeEngine:
 
     def _push(self, fn, const_vars, mutable_vars, priority, name, is_async):
         const_vars, mutable_vars = _dedup(const_vars, mutable_vars)
+        t_q = _telemetry.clock_ns() if _telemetry.enabled("engine") else 0
         with self._pending_lock:
             key = self._next_key[0]
             self._next_key[0] += 1
-            self._pending[key] = (fn, is_async)
+            self._pending[key] = (fn, is_async, name, t_q,
+                                  tuple(const_vars), tuple(mutable_vars))
         c = (ctypes.c_int64 * max(len(const_vars), 1))(*const_vars)
         m = (ctypes.c_int64 * max(len(mutable_vars), 1))(*mutable_vars)
         self._lib.mxe_push(self._h, self._trampoline, ctypes.c_void_p(key),
@@ -215,33 +237,42 @@ class PythonEngine:
     def delete_variable(self, var):
         pass
 
-    def _run_profiled(self, fn, name):
+    def _run_profiled(self, fn, name, t_q=0):
         import time
 
         t0 = time.time()
-        fn()
+        if t_q and _telemetry.enabled("engine"):
+            with _telemetry.span(
+                    name, domain="engine",
+                    queue_us=(_telemetry.clock_ns() - t_q) // 1000):
+                fn()
+        else:
+            fn()
         if self._profiling:
             self._prof.append({"name": name, "ph": "X", "pid": 0, "tid": 0,
                                "ts": int(t0 * 1e6),
                                "dur": int((time.time() - t0) * 1e6)})
 
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0, name="op"):
+        t_q = _telemetry.clock_ns() if _telemetry.enabled("engine") else 0
         if self._queue is not None:
-            self._queue.put(lambda: self._run_profiled(fn, name))
+            self._queue.put(lambda: self._run_profiled(fn, name, t_q))
         else:
-            self._run_profiled(fn, name)
+            self._run_profiled(fn, name, t_q)
 
     def push_async(self, fn, const_vars=(), mutable_vars=(), priority=0,
                    name="op"):
+        t_q = _telemetry.clock_ns() if _telemetry.enabled("engine") else 0
+
         def run():
             done = threading.Event()
             fn(done.set)
             done.wait()  # hold the FIFO slot until on_complete fires
 
         if self._queue is not None:
-            self._queue.put(lambda: self._run_profiled(run, name))
+            self._queue.put(lambda: self._run_profiled(run, name, t_q))
         else:
-            self._run_profiled(run, name)
+            self._run_profiled(run, name, t_q)
 
     def wait_for_var(self, var):
         # conservative: the FIFO admits no reordering, so draining it is a
@@ -312,7 +343,8 @@ def wait_for_var(var):
 
 
 def wait_for_all():
-    get().wait_for_all()
+    with _telemetry.span("engine.wait_for_all", domain="engine"):
+        get().wait_for_all()
     _raise_pending_file_error()
 
 
@@ -337,7 +369,10 @@ class Fence:
 
     def wait(self, timeout: Optional[float] = None) -> "Fence":
         """Block for the barrier; raises MXNetError on timeout."""
-        if not self._event.wait(timeout):
+        with _telemetry.span("engine.fence.wait", domain="engine",
+                             n_vars=self.n_vars):
+            reached = self._event.wait(timeout)
+        if not reached:
             raise MXNetError(
                 "engine fence over %d var(s) not reached after %.3fs"
                 % (self.n_vars, timeout))
@@ -494,3 +529,11 @@ def wait_for_all_files():
     if first_err is not None:
         raise first_err
     _raise_pending_file_error()
+
+
+# queue depth for the metrics registry — the callback reads the module
+# global at scrape time and never instantiates an engine itself
+_telemetry.registry.gauge(
+    "engine_pending_ops",
+    fn=lambda: _engine.pending() if _engine is not None else 0,
+    help="ops queued or running on the host dependency engine")

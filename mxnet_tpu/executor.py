@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import random as _random
+from . import telemetry as _telemetry
 from .base import MXNetError
 from .context import Context, default_context
 from .ndarray import NDArray
@@ -318,7 +319,7 @@ class Executor:
         jitted = None if use_auto else jax.jit(step, donate_argnums=(0, 1))
         aot = {}  # compiled, in_formats, placed (built on first call)
 
-        def run(params, states, data_values, *extra):
+        def _run_impl(params, states, data_values, *extra):
             rng = self._next_rng()
             aux_values = {n: a._data for n, a in self.aux_dict.items()}
             dv = {n: (v._data if isinstance(v, NDArray) else jnp.asarray(v))
@@ -404,6 +405,14 @@ class Executor:
             self.outputs = [NDArray(o) for o in outs]
             return outs, new_params, new_states
 
+        def run(params, states, data_values, *extra):
+            # jit dispatch is async: the span measures the HOST side of the
+            # step (argument prep, dispatch, first-call trace+compile); the
+            # device timeline comes from the jax trace merged at dump time
+            with _telemetry.span("executor.train_step", domain="executor",
+                                 chain=chain, sharded=bool(sharded)):
+                return _run_impl(params, states, data_values, *extra)
+
         return run
 
     def _next_rng(self):
@@ -437,7 +446,9 @@ class Executor:
         if self._monitor_should_run(rng):
             self._run_monitored(arg_values, aux_values, is_train, rng)
         fn = self._get_fwd(bool(is_train))
-        outs, aux_up = fn(arg_values, aux_values, rng)
+        with _telemetry.span("executor.forward", domain="executor",
+                             is_train=bool(is_train)):
+            outs, aux_up = fn(arg_values, aux_values, rng)
         if is_train:
             for n, v in aux_up.items():
                 self.aux_dict[n]._data = v
@@ -462,7 +473,9 @@ class Executor:
         heads = None if out_grads is None else [g._data for g in out_grads]
         old = {n: self.grad_dict[n]._data for n in self._grad_names_list()
                if self.grad_req[n] == "add"}
-        outs, aux_up, new_grads = fn(arg_values, aux_values, rng, heads, old)
+        with _telemetry.span("executor.backward", domain="executor"):
+            outs, aux_up, new_grads = fn(arg_values, aux_values, rng,
+                                         heads, old)
         for n, g in zip(self._grad_names_list(), new_grads):
             self.grad_dict[n]._data = g
         for n, v in aux_up.items():

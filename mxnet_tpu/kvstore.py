@@ -36,8 +36,23 @@ import jax
 import numpy as np
 
 from . import ndarray as nd
+from . import telemetry
 from .base import MXNetError
 from .ndarray import NDArray
+
+# traffic counters (default-on; MXNET_TELEMETRY=0 makes inc() a no-op),
+# created once at import so the hot path is a single bound-method call —
+# the registry surfaces them in exposition()/get_name_value()
+_push_total = telemetry.registry.counter(
+    "kvstore_push_total", help="kvstore push calls (keys)")
+_push_bytes = telemetry.registry.counter(
+    "kvstore_push_bytes_total", help="gradient bytes pushed")
+_pull_total = telemetry.registry.counter(
+    "kvstore_pull_total", help="kvstore pull calls (keys)")
+_pull_bytes = telemetry.registry.counter(
+    "kvstore_pull_bytes_total", help="weight bytes pulled")
+_barrier_total = telemetry.registry.counter(
+    "kvstore_barrier_total", help="kvstore barrier calls")
 
 
 class KVStore:
@@ -60,10 +75,12 @@ class KVStore:
     def barrier(self):
         """Global barrier (reference Barrier → ps::Postoffice::Barrier).
         On jax runtime: a tiny all-reduce forces synchronization."""
+        _barrier_total.inc()
         if self._is_dist and jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
-            multihost_utils.sync_global_devices("kvstore_barrier")
+            with telemetry.span("kvstore.barrier", domain="kvstore"):
+                multihost_utils.sync_global_devices("kvstore_barrier")
 
     # --- data plane -------------------------------------------------------
     def init(self, key, value):
@@ -80,51 +97,67 @@ class KVStore:
 
         value may be one NDArray or a list (one per device) per key."""
         keys, grouped = _group_kv(key, value)
-        for k, vals in zip(keys, grouped):
-            merged = _reduce(vals)
-            if self._updater is not None:
-                if k not in self._store:
-                    raise MXNetError("push to uninitialized key %r" % (k,))
-                stored = self._store[k]
-                ssh = stored._data.sharding
-                gsh = merged._data.sharding
-                if ssh != gsh:
-                    if (ssh.device_set == gsh.device_set
-                            and not ssh.is_fully_replicated):
-                        # the stored master value is deliberately sharded
-                        # over the same mesh (ZeRO-1 weight-update layout):
-                        # bring the merged gradient TO the shards (the
-                        # resharding device_put IS the reduce_scatter leg)
-                        # instead of destroying the stored layout
-                        merged = NDArray(jax.device_put(merged._data, ssh))
-                    else:
-                        # adopt the gradient's (mesh) sharding so the fused
-                        # update runs where the executor's arrays live — the
-                        # analogue of the reference's merge-buffer placement
-                        # (comm.h:333-361)
-                        stored._data = jax.device_put(stored._data, gsh)
-                self._updater(_updater_key(k), merged, stored)
-            else:
-                self._store[k] = merged
+        nbytes = 0
+        with telemetry.span("kvstore.push", domain="kvstore",
+                            n_keys=len(keys)):
+            for k, vals in zip(keys, grouped):
+                merged = _reduce(vals)
+                nbytes += merged._data.nbytes
+                if self._updater is not None:
+                    if k not in self._store:
+                        raise MXNetError(
+                            "push to uninitialized key %r" % (k,))
+                    stored = self._store[k]
+                    ssh = stored._data.sharding
+                    gsh = merged._data.sharding
+                    if ssh != gsh:
+                        if (ssh.device_set == gsh.device_set
+                                and not ssh.is_fully_replicated):
+                            # the stored master value is deliberately sharded
+                            # over the same mesh (ZeRO-1 weight-update
+                            # layout): bring the merged gradient TO the
+                            # shards (the resharding device_put IS the
+                            # reduce_scatter leg) instead of destroying the
+                            # stored layout
+                            merged = NDArray(jax.device_put(merged._data,
+                                                            ssh))
+                        else:
+                            # adopt the gradient's (mesh) sharding so the
+                            # fused update runs where the executor's arrays
+                            # live — the analogue of the reference's
+                            # merge-buffer placement (comm.h:333-361)
+                            stored._data = jax.device_put(stored._data, gsh)
+                    self._updater(_updater_key(k), merged, stored)
+                else:
+                    self._store[k] = merged
+        _push_total.inc(len(keys))
+        _push_bytes.inc(nbytes)
 
     def pull(self, key, out=None, priority=0):
         """Broadcast stored value into out array(s) (reference
         KVStoreLocal::Pull → Comm::Broadcast, kvstore_local.h:75-88)."""
         keys, grouped = _group_kv(key, out)
-        for k, outs in zip(keys, grouped):
-            if k not in self._store:
-                raise MXNetError("pull of uninitialized key %r" % (k,))
-            src = self._store[k]
-            for o in outs:
-                # broadcast into the target's own sharding (replicated over
-                # the mesh for params) — Comm::Broadcast (comm.h:268). When
-                # the stored value is ZeRO-1 sharded (dist_sync with the
-                # sharded update) this device_put is the weight all-gather:
-                # the puller always receives full values, never a bare shard
-                if o._data.sharding != src._data.sharding:
-                    o._data = jax.device_put(src._data, o._data.sharding)
-                else:
-                    o._data = src._data
+        nbytes = 0
+        with telemetry.span("kvstore.pull", domain="kvstore",
+                            n_keys=len(keys)):
+            for k, outs in zip(keys, grouped):
+                if k not in self._store:
+                    raise MXNetError("pull of uninitialized key %r" % (k,))
+                src = self._store[k]
+                for o in outs:
+                    # broadcast into the target's own sharding (replicated
+                    # over the mesh for params) — Comm::Broadcast
+                    # (comm.h:268). When the stored value is ZeRO-1 sharded
+                    # (dist_sync with the sharded update) this device_put is
+                    # the weight all-gather: the puller always receives full
+                    # values, never a bare shard
+                    if o._data.sharding != src._data.sharding:
+                        o._data = jax.device_put(src._data, o._data.sharding)
+                    else:
+                        o._data = src._data
+                    nbytes += o._data.nbytes
+        _pull_total.inc(len(keys))
+        _pull_bytes.inc(nbytes)
 
     # --- updater / optimizer ---------------------------------------------
     def set_updater(self, updater):
@@ -307,21 +340,35 @@ class PSKVStore(KVStore):
         import jax.numpy as jnp
 
         keys, grouped = _group_kv(key, value)
-        for k, vals in zip(keys, grouped):
-            merged = _reduce(vals)  # local device reduce before the wire
-            # device-side copy: the caller's buffer may be DONATED by the
-            # next fused step before the engine op reads it back; the copy
-            # is a fresh buffer, and the (slow, tunneled) D2H readback
-            # still overlaps training inside the engine op
-            m = NDArray(jnp.copy(merged._data))
-            self._engine.get().push(
-                lambda k=k, m=m: self._safe_rpc(
-                    lambda: self._client.push(k, m.asnumpy())),
-                mutable_vars=[self._key_var(k)], priority=priority,
-                name="ps_push")
+        nbytes = 0
+        with telemetry.span("kvstore.push", domain="kvstore",
+                            n_keys=len(keys), ps=True):
+            for k, vals in zip(keys, grouped):
+                merged = _reduce(vals)  # local device reduce before the wire
+                nbytes += merged._data.nbytes
+                # device-side copy: the caller's buffer may be DONATED by
+                # the next fused step before the engine op reads it back;
+                # the copy is a fresh buffer, and the (slow, tunneled) D2H
+                # readback still overlaps training inside the engine op
+                m = NDArray(jnp.copy(merged._data))
+                self._engine.get().push(
+                    lambda k=k, m=m: self._safe_rpc(
+                        lambda: self._client.push(k, m.asnumpy())),
+                    mutable_vars=[self._key_var(k)], priority=priority,
+                    name="ps_push")
+        _push_total.inc(len(keys))
+        _push_bytes.inc(nbytes)
 
     def pull(self, key, out=None, priority=0):
         keys, grouped = _group_kv(key, out)
+        with telemetry.span("kvstore.pull", domain="kvstore",
+                            n_keys=len(keys), ps=True):
+            self._pull_impl(keys, grouped, priority)
+        _pull_total.inc(len(keys))
+        _pull_bytes.inc(sum(o._data.nbytes
+                            for outs in grouped for o in outs))
+
+    def _pull_impl(self, keys, grouped, priority):
         for k, outs in zip(keys, grouped):
             ref_shape = tuple(outs[0].shape)
 
@@ -366,10 +413,12 @@ class PSKVStore(KVStore):
         return len(self._client.dead_nodes(timeout_sec))
 
     def barrier(self):
-        # flush every queued push/pull first: a barrier with RPCs still in
-        # the engine queue would not be a barrier
-        self._engine.fence(list(self._key_vars.values()),
-                           name="ps_barrier_fence").wait()
+        _barrier_total.inc()
+        with telemetry.span("kvstore.barrier", domain="kvstore", ps=True):
+            # flush every queued push/pull first: a barrier with RPCs still
+            # in the engine queue would not be a barrier
+            self._engine.fence(list(self._key_vars.values()),
+                               name="ps_barrier_fence").wait()
         self._raise_pending()
         if self._recovery:
             # startup barrier skip (reference is_recovery,

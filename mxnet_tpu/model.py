@@ -15,6 +15,7 @@ import numpy as np
 
 from . import ndarray as nd
 from . import symbol as sym_mod
+from . import telemetry
 from .base import MXNetError
 from .context import cpu
 
@@ -59,30 +60,35 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
     """(reference model.py:88-97)."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
-        kvstore.push(index, grad_list, priority=-index)
-        kvstore.pull(index, arg_list, priority=-index)
+    with telemetry.span("model.update_params_on_kvstore",
+                        domain="executor", n_params=len(param_arrays)):
+        for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+            arg_list, grad_list = pair
+            if grad_list[0] is None:
+                continue
+            kvstore.push(index, grad_list, priority=-index)
+            kvstore.pull(index, arg_list, priority=-index)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None):
     """(reference model.py:99-122). All per-key updates are batched into one
     jitted program per device slot via Updater.update_all."""
-    per_slot = {}
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
-        if kvstore:
-            kvstore.push(index, grad_list, priority=-index)
-            kvstore.pull(index, grad_list, priority=-index)
-        for k, p in enumerate(zip(arg_list, grad_list)):
-            w, g = p
-            per_slot.setdefault(k, []).append((index * num_device + k, g, w))
-    for pairs in per_slot.values():
-        updater.update_all(pairs)
+    with telemetry.span("model.update_params", domain="executor",
+                        n_params=len(param_arrays)):
+        per_slot = {}
+        for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+            arg_list, grad_list = pair
+            if grad_list[0] is None:
+                continue
+            if kvstore:
+                kvstore.push(index, grad_list, priority=-index)
+                kvstore.pull(index, grad_list, priority=-index)
+            for k, p in enumerate(zip(arg_list, grad_list)):
+                w, g = p
+                per_slot.setdefault(k, []).append(
+                    (index * num_device + k, g, w))
+        for pairs in per_slot.values():
+            updater.update_all(pairs)
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
@@ -100,11 +106,15 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
     # snapshot NOW: rewrap the current (immutable) device buffers so later
-    # training steps can't bleed into an in-flight async write
-    save_dict = {("arg:%s" % k): nd.NDArray(v._data)
-                 for k, v in arg_params.items()}
-    save_dict.update({("aux:%s" % k): nd.NDArray(v._data)
-                      for k, v in aux_params.items()})
+    # training steps can't bleed into an in-flight async write; the span
+    # covers only this host-side snapshot — the blob write is an engine op
+    # that shows up as its own engine-domain event
+    with telemetry.span("model.checkpoint_snapshot", domain="executor",
+                        epoch=epoch, n_params=len(arg_params)):
+        save_dict = {("arg:%s" % k): nd.NDArray(v._data)
+                     for k, v in arg_params.items()}
+        save_dict.update({("aux:%s" % k): nd.NDArray(v._data)
+                          for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
     engine.push_file_write(param_name,
                            lambda: nd.save(param_name, save_dict),

@@ -2,14 +2,28 @@
 
 Reimplementation of python/mxnet/monitor.py (SURVEY §5.1): regex-selected
 per-array stats collected via the executor monitor callback
-(graph_executor.cc:761-781 equivalent in executor.py)."""
+(graph_executor.cc:761-781 equivalent in executor.py).
+
+Stat computation rides the host engine: every tap is pushed as an engine
+op on a monitor-owned variable, so the training thread never pays for
+``stat_func`` (reference monitor.py blocks on it inline), and draining is
+one ``engine.fence([var]).wait()`` — the real happens-before edge over
+all pushed taps — plus a single tree-level ``jax.block_until_ready`` for
+device settlement, instead of a per-array ``wait_to_read`` loop (the
+analysis suite's ``drain-as-fence`` antipattern). Ops on one variable
+serialize, so ``self.queue`` needs no lock.
+"""
 from __future__ import annotations
 
 import logging
 import re
 from math import sqrt
 
+import jax
+
+from . import engine
 from . import ndarray as nd
+from . import telemetry
 from .ndarray import NDArray
 
 
@@ -28,16 +42,45 @@ class Monitor:
         self.exes = []
         self.re_prog = re.compile(pattern)
         self.sort = sort
+        self._var = None  # engine variable serializing the stat taps
 
         def stat_helper(name, arr):
             if not self.activated or not self.re_prog.match(name):
                 return
-            self.queue.append((self.step, name, self.stat_func(arr)))
+            self._push_stat(self.step, name, arr)
 
         # executors probe this to skip the (costly) internal-output
         # evaluation entirely on batches where the monitor is idle
         stat_helper.is_active = lambda: self.activated
         self.stat_helper = stat_helper
+
+    def _stat_var(self):
+        if self._var is None:
+            self._var = engine.new_variable()
+        return self._var
+
+    def _push_stat(self, step, name, arr):
+        """Queue one stat computation on an engine worker. ``arr`` wraps an
+        immutable jax.Array, so the deferred read is a consistent
+        snapshot; the monitor var orders taps in push order."""
+        def compute(step=step, name=name, arr=arr):
+            with telemetry.span("monitor.stat", domain="monitor",
+                                stat=name):
+                self.queue.append((step, name, self.stat_func(arr)))
+
+        engine.push(compute, mutable_vars=[self._stat_var()],
+                    name="monitor_stat")
+
+    def _drain(self):
+        """Fence the monitor var (all pushed taps have appended to
+        ``queue``) and settle the executors' device arrays in one call."""
+        with telemetry.span("monitor.drain", domain="monitor",
+                            n_exes=len(self.exes)):
+            if self._var is not None:
+                engine.fence([self._var], name="monitor_fence").wait()
+            arrs = [a._data for exe in self.exes for a in exe.arg_arrays]
+            if arrs:
+                jax.block_until_ready(arrs)
 
     def install(self, exe):
         exe.set_monitor_callback(self.stat_helper)
@@ -45,9 +88,7 @@ class Monitor:
 
     def tic(self):
         if self.step % self.interval == 0:
-            for exe in self.exes:
-                for array in exe.arg_arrays:
-                    array.wait_to_read()
+            self._drain()
             self.queue = []
             self.activated = True
         self.step += 1
@@ -56,12 +97,11 @@ class Monitor:
         if not self.activated:
             return []
         for exe in self.exes:
-            for array in exe.arg_arrays:
-                array.wait_to_read()
-        for exe in self.exes:
-            for name, array in zip(exe._symbol.list_arguments(), exe.arg_arrays):
+            for name, array in zip(exe._symbol.list_arguments(),
+                                   exe.arg_arrays):
                 if self.re_prog.match(name):
-                    self.queue.append((self.step, name, self.stat_func(array)))
+                    self._push_stat(self.step, name, array)
+        self._drain()
         self.activated = False
         res = []
         if self.sort:

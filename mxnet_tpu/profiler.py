@@ -1,17 +1,31 @@
 """Profiler controls.
 
 TPU-native analogue of python/mxnet/profiler.py + src/engine/profiler.cc
-(SURVEY §5.1). The reference stamps per-op begin/end in engine workers and
-dumps chrome://tracing JSON. Here the equivalent machinery is jax.profiler
-(XLA traces → TensorBoard/perfetto, which chrome://tracing reads); this
-module preserves the reference API surface and maps it onto jax.profiler.
+(SURVEY §5.1). The reference stamps per-op begin/end in engine workers
+and dumps chrome://tracing JSON (MXDumpProfile). Here the host half of
+that picture comes from :mod:`mxnet_tpu.telemetry` (per-thread span ring
+buffers instrumenting the engine, serving, kvstore and executor layers)
+plus the engine's own per-op events; the device half is a jax.profiler
+trace (XLA → TensorBoard/perfetto). ``dump_profile()`` merges all of it
+into ONE chrome://tracing-loadable JSON file — and it ALWAYS writes that
+file at the configured ``filename`` (logging the path), even when the
+jax trace was never started and even with zero host events, so a
+CPU-only run has real output (docs/observability.md).
 """
 from __future__ import annotations
 
+import glob
+import gzip
+import json
 import logging
 import os
 
-_state = {"running": False, "dir": None, "filename": "profile.json"}
+from . import telemetry
+
+_log = logging.getLogger("mxnet_tpu")
+
+_state = {"running": False, "dir": None, "filename": "profile.json",
+          "jax": False, "engine_prof": False, "prev_domains": None}
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):
@@ -22,25 +36,111 @@ def profiler_set_config(mode="symbolic", filename="profile.json"):
 
 def profiler_set_state(state="stop"):
     """(reference profiler.py profiler_set_state / MXSetProfilerState).
-    'run' starts a jax.profiler trace; 'stop' ends it and writes the trace
-    directory next to the configured filename."""
-    import jax
 
+    ``'run'`` enables host telemetry spans (every domain unless
+    ``MXNET_PROFILER`` names a subset), turns on the engine's per-op
+    profiling, and — unless ``MXNET_PROFILER_JAX=0`` — starts a
+    jax.profiler trace under ``<dir>/jax_trace``. ``'stop'`` ends the
+    window; ``dump_profile()`` flushes everything to one JSON file."""
     if state == "run" and not _state["running"]:
-        trace_dir = (_state["dir"] or ".") + "/jax_trace"
-        os.makedirs(trace_dir, exist_ok=True)
-        jax.profiler.start_trace(trace_dir)
+        _state["prev_domains"] = (telemetry.enabled_domains()
+                                  if telemetry.enabled_domains() else None)
+        telemetry.enable_spans(os.environ.get("MXNET_PROFILER") or "all")
+        telemetry.mark_begin("mxnet_profile", domain="profiler")
+        try:
+            from . import engine
+
+            engine.get().set_profiling(True)
+            _state["engine_prof"] = True
+        except Exception:
+            _state["engine_prof"] = False
+        if os.environ.get("MXNET_PROFILER_JAX", "1") != "0":
+            try:
+                import jax
+
+                trace_dir = (_state["dir"] or ".") + "/jax_trace"
+                os.makedirs(trace_dir, exist_ok=True)
+                jax.profiler.start_trace(trace_dir)
+                _state["jax"] = True
+            except Exception:
+                _log.exception("jax.profiler trace failed to start; "
+                               "host-span profiling continues")
+                _state["jax"] = False
         _state["running"] = True
     elif state == "stop" and _state["running"]:
-        jax.profiler.stop_trace()
+        telemetry.mark_end("mxnet_profile", domain="profiler")
+        if _state["jax"]:
+            import jax
+
+            jax.profiler.stop_trace()
+            _state["jax"] = False
+            _log.info("profiler trace written under %s/jax_trace",
+                      _state["dir"] or ".")
+        if _state["engine_prof"]:
+            try:
+                from . import engine
+
+                engine.get().set_profiling(False)
+            except Exception:
+                pass
+        if _state["prev_domains"]:
+            telemetry.enable_spans(_state["prev_domains"])
+        else:
+            telemetry.disable_spans()
         _state["running"] = False
-        logging.info("profiler trace written under %s/jax_trace", _state["dir"] or ".")
 
 
-def dump_profile():
-    """(reference MXDumpProfile) — stop and flush."""
+def _jax_trace_events(trace_dir: str):
+    """Best-effort: pull traceEvents out of the jax/XLA trace artifacts
+    (``*.trace.json.gz`` under the TensorBoard plugin layout) so device
+    and host events share one timeline file."""
+    events = []
+    try:
+        for path in glob.glob(os.path.join(trace_dir, "**", "*.trace.json*"),
+                              recursive=True):
+            try:
+                opener = gzip.open if path.endswith(".gz") else open
+                with opener(path, "rt") as f:
+                    data = json.load(f)
+                evs = data.get("traceEvents", []) \
+                    if isinstance(data, dict) else []
+                events.extend(e for e in evs if isinstance(e, dict))
+            except Exception:
+                continue
+    except Exception:
+        pass
+    return events
+
+
+def dump_profile() -> str:
+    """(reference MXDumpProfile) — stop the window if running and write
+    the merged chrome://tracing JSON at the configured ``filename``.
+
+    Always writes (zero events included) and returns the absolute path;
+    host spans come from ``telemetry`` (drained — a second dump only
+    contains newer events), engine per-op events from the native/python
+    engine profiler when it was on, device events from the jax trace dir
+    when one exists."""
     if _state["running"]:
         profiler_set_state("stop")
+    path = os.path.abspath(_state["filename"])
+    events = telemetry.chrome_events(clear=True)
+    n_host = len(events)
+    if _state["engine_prof"]:
+        try:
+            from . import engine
+
+            events.extend(engine.get().dump_profile().get("traceEvents", []))
+        except Exception:
+            pass
+        _state["engine_prof"] = False
+    events.extend(_jax_trace_events((_state["dir"] or ".") + "/jax_trace"))
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    _log.info("profile dumped to %s (%d events, %d host spans)",
+              path, len(events), n_host)
+    return path
 
 
 class TraceAnnotation:

@@ -18,6 +18,8 @@ import time
 from collections import deque, namedtuple
 from typing import Callable, Dict, List, Optional, Sequence
 
+from .. import telemetry
+
 ServingBatchEndParam = namedtuple(
     "ServingBatchEndParam",
     ["nbatch", "bucket", "rows", "replica", "latency_ms", "occupancy",
@@ -51,6 +53,10 @@ class ServingMetrics:
         self._queue_depth_fn = queue_depth_fn
         self._cache_stats_fn = cache_stats_fn
         self.reset()
+        # no longer a metrics island: the central registry adopts this
+        # instance (weakref'd) so registry.exposition() carries every
+        # serving gauge as serving_<name>{sid="..."} (docs/deployment.md)
+        self.sid = telemetry.registry.register_group("serving", self)
 
     def reset(self):
         with self._lock:

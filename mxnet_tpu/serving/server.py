@@ -33,6 +33,7 @@ import numpy as np
 
 from .. import engine
 from .. import predict as predict_mod
+from .. import telemetry
 from .batcher import BatchFormer, Request, ServingError
 from .bucket_cache import BucketCache
 from .metrics import ServingBatchEndParam, ServingMetrics
@@ -217,6 +218,7 @@ class InferenceServer:
         t = self.config.timeout_ms if timeout_ms is None else timeout_ms
         deadline = (time.monotonic() + t / 1e3) if t and t > 0 else None
         req = Request(feed, rows, deadline)
+        telemetry.instant("serving.submit", domain="serving", rows=rows)
         self.metrics.record_submit(rows)
         try:
             self._former.submit(req)
@@ -238,9 +240,19 @@ class InferenceServer:
     # --- former loop + dispatch -------------------------------------------
     def _former_loop(self):
         while True:
-            batch = self._former.next_batch()
+            with telemetry.span("serving.form_batch", domain="serving") as sp:
+                batch = self._former.next_batch()
+                if batch is not None:
+                    sp.annotate(n_requests=len(batch))
             if batch is None:
                 return
+            if telemetry.enabled("serving"):
+                # queue time per request: submitted is time.monotonic(),
+                # the same clock the tracer stamps in, so the span is exact
+                for r in batch:
+                    telemetry.complete("serving.queued", domain="serving",
+                                       start_ns=int(r.submitted * 1e9),
+                                       rows=r.rows)
             rep = self._replicas[self._rr % len(self._replicas)]
             self._rr += 1
             self._nbatch += 1
@@ -253,19 +265,36 @@ class InferenceServer:
 
     def _dispatch(self, batch: List[Request], rep: _Replica, nbatch: int,
                   on_complete: Callable[[], None]):
+        # entered/exited manually so the span brackets the whole dispatch
+        # (success and failure paths) without re-nesting the handler
+        sp = telemetry.span("serving.dispatch", domain="serving",
+                            nbatch=nbatch, replica=rep.index)
+        sp.__enter__()
         try:
             rows = sum(r.rows for r in batch)
             bucket = rep.cache.bucket_for(rows)
+            if telemetry.enabled("serving"):
+                now = time.monotonic()
+                margins = [(r.deadline - now) * 1e3 for r in batch
+                           if r.deadline is not None]
+                sp.annotate(bucket=bucket, rows=rows,
+                            deadline_margin_ms=(round(min(margins), 3)
+                                                if margins else None))
             exe = rep.cache.get(bucket)
-            feed = {}
-            for name in self._input_names:
-                cat = np.concatenate([r.inputs[name] for r in batch], axis=0)
-                if bucket > rows:
-                    pad = np.zeros((bucket - rows,) + cat.shape[1:],
-                                   cat.dtype)
-                    cat = np.concatenate([cat, pad], axis=0)
-                feed[name] = cat
-            outs = [o.asnumpy() for o in exe.forward(**feed)]
+            with telemetry.span("serving.pad", domain="serving",
+                                bucket=bucket, rows=rows):
+                feed = {}
+                for name in self._input_names:
+                    cat = np.concatenate(
+                        [r.inputs[name] for r in batch], axis=0)
+                    if bucket > rows:
+                        pad = np.zeros((bucket - rows,) + cat.shape[1:],
+                                       cat.dtype)
+                        cat = np.concatenate([cat, pad], axis=0)
+                    feed[name] = cat
+            with telemetry.span("serving.forward", domain="serving",
+                                bucket=bucket):
+                outs = [o.asnumpy() for o in exe.forward(**feed)]
             for o in outs:
                 if o.shape[:1] != (bucket,):
                     raise ServingError(
@@ -301,6 +330,7 @@ class InferenceServer:
                 if not r.done():
                     r.set_error(err)
         finally:
+            sp.__exit__(None, None, None)
             on_complete()
 
     # --- introspection ----------------------------------------------------

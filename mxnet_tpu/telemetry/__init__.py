@@ -1,0 +1,30 @@
+"""mxnet_tpu.telemetry — process-wide tracing + metrics (ISSUE 4).
+
+Two halves, both with branch-and-return disabled paths:
+
+- **tracing** (:mod:`.tracer`): per-thread ring-buffer span recorder.
+  Spans are OFF by default; enable domains with
+  ``MXNET_PROFILER=engine,serving,kvstore`` (or ``all``), or
+  programmatically via :func:`enable_spans`. ``profiler.dump_profile()``
+  drains every buffer into a chrome://tracing JSON.
+- **metrics** (:mod:`.metrics`): the central :data:`registry` of
+  counters/gauges/histograms plus adopted metric groups (ServingMetrics
+  et al.), with ``get_name_value()`` and Prometheus ``exposition()``.
+  Counters are ON by default; ``MXNET_TELEMETRY=0`` kills everything.
+
+See docs/observability.md. Instrumentation must live OUTSIDE
+jitted/shard_mapped functions — enforced by
+``mxnet_tpu.analysis.trace_purity`` (rule ``telemetry-in-jit``).
+"""
+from .tracer import (begin, chrome_events, clock_ns, complete,
+                     disable_spans, drain_events, enable_spans, enabled,
+                     enabled_domains, end, instant, mark_begin, mark_end,
+                     reset, span)
+from .metrics import Counter, Gauge, Histogram, Registry, registry
+
+__all__ = [
+    "span", "begin", "end", "complete", "instant", "mark_begin", "mark_end",
+    "enabled", "enable_spans", "disable_spans", "enabled_domains",
+    "drain_events", "chrome_events", "clock_ns", "reset",
+    "registry", "Registry", "Counter", "Gauge", "Histogram",
+]

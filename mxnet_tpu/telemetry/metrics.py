@@ -1,0 +1,273 @@
+"""Central metrics registry — counters / gauges / histograms.
+
+The surface keeps metric.py's ``get_name_value()`` convention (parallel
+name/value lists zipped into pairs) and adds ``exposition()`` rendering
+the Prometheus text format — the seam for a future HTTP front-end
+(ROADMAP serving SLOs).
+
+Counters are ON by default (``MXNET_TELEMETRY=0`` turns every mutation
+into a branch-and-return); unlike spans they need no domain selection —
+an ``inc()`` is one lock + add.
+
+Locking discipline (mxnet_tpu.analysis lockorder): ``Registry._lock``
+guards only the name→metric tables; renders and reads snapshot the
+tables under the lock and evaluate metric values (including gauge
+callbacks — user code) OUTSIDE it.
+"""
+from __future__ import annotations
+
+import math
+import re
+import threading
+import weakref
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .tracer import _master_enabled
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _sanitize(name: str) -> str:
+    name = _NAME_RE.sub("_", name)
+    return name if name and not name[0].isdigit() else "_" + name
+
+
+class Counter:
+    """Monotonic counter (``get_name_value()`` → one pair)."""
+
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n=1):
+        if not _master_enabled():
+            return
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+    def get_name_value(self):
+        return [(self.name, self._value)]
+
+
+class Gauge:
+    """Point-in-time value: either ``set()`` explicitly or computed by a
+    callback ``fn`` at read time (e.g. the engine's pending-op depth)."""
+
+    __slots__ = ("name", "help", "_value", "_fn")
+
+    def __init__(self, name: str, fn: Optional[Callable[[], float]] = None,
+                 help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+        self._fn = fn
+
+    def set(self, v):
+        if not _master_enabled():
+            return
+        self._value = v
+
+    @property
+    def value(self):
+        if self._fn is not None:
+            try:
+                return self._fn()
+            except Exception:
+                return float("nan")
+        return self._value
+
+    def get_name_value(self):
+        return [(self.name, self.value)]
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus ``le`` semantics)."""
+
+    __slots__ = ("name", "help", "buckets", "_counts", "_sum", "_count",
+                 "_lock")
+
+    DEFAULT_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100,
+                      250, 500, 1000)
+
+    def __init__(self, name: str, buckets: Sequence[float] = (),
+                 help: str = ""):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(sorted(buckets)) or self.DEFAULT_BUCKETS
+        self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float):
+        if not _master_enabled():
+            return
+        i = 0
+        for b in self.buckets:
+            if v <= b:
+                break
+            i += 1
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self) -> Tuple[List[int], float, int]:
+        with self._lock:
+            return list(self._counts), self._sum, self._count
+
+    def get_name_value(self):
+        counts, s, n = self.snapshot()
+        return [("%s_sum" % self.name, s), ("%s_count" % self.name, n)]
+
+
+class Registry:
+    """Process-wide metric registry (``telemetry.registry`` singleton).
+
+    ``counter``/``gauge``/``histogram`` are get-or-create by name.
+    ``register_group(prefix, obj)`` adopts an object exposing
+    ``get_name_value()`` (the metric.py convention — e.g. a live
+    ``ServingMetrics``) wholesale: the registry holds only a weakref, so
+    short-lived servers don't leak, and each instance gets a stable
+    ``sid`` label in the exposition.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+        self._groups: List[Tuple[str, int, "weakref.ref"]] = []
+        self._next_sid = 0
+
+    def _get_or_create(self, name, cls, *args, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+        if m is None:
+            # construct outside the lock (lockorder: no callable runs under
+            # _lock); a racing creator loses benignly to setdefault
+            fresh = cls(name, *args, **kwargs)
+            with self._lock:
+                m = self._metrics.setdefault(name, fresh)
+        if not isinstance(m, cls):
+            raise TypeError("metric %r already registered as %s"
+                            % (name, type(m).__name__))
+        return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(name, Counter, help)
+
+    def gauge(self, name: str, fn: Optional[Callable[[], float]] = None,
+              help: str = "") -> Gauge:
+        return self._get_or_create(name, Gauge, fn, help)
+
+    def histogram(self, name: str, buckets: Sequence[float] = (),
+                  help: str = "") -> Histogram:
+        return self._get_or_create(name, Histogram, buckets, help)
+
+    def register_group(self, prefix: str, obj) -> int:
+        """Adopt ``obj.get_name_value()`` under ``prefix`` (weakref'd);
+        returns the instance's ``sid`` label value."""
+        with self._lock:
+            sid = self._next_sid
+            self._next_sid += 1
+            self._groups.append((prefix, sid, weakref.ref(obj)))
+            return sid
+
+    # --- reads (no user code under _lock) --------------------------------
+    def _snapshot(self):
+        with self._lock:
+            metrics = list(self._metrics.values())
+            groups = list(self._groups)
+        live = []
+        dead = False
+        for prefix, sid, ref in groups:
+            obj = ref()
+            if obj is None:
+                dead = True
+            else:
+                live.append((prefix, sid, obj))
+        if dead:  # prune collected groups so the table stays bounded
+            with self._lock:
+                self._groups = [g for g in self._groups if g[2]() is not None]
+        return metrics, live
+
+    def get(self):
+        """(names, values) — metric.py's EvalMetric.get() shape, covering
+        registry metrics and live groups (group entries as
+        ``<prefix>_<name>``)."""
+        metrics, groups = self._snapshot()
+        names, values = [], []
+        for m in metrics:
+            for n, v in m.get_name_value():
+                names.append(n)
+                values.append(v)
+        for prefix, _sid, obj in groups:
+            for n, v in obj.get_name_value():
+                names.append("%s_%s" % (prefix, _sanitize(str(n))))
+                values.append(v)
+        return names, values
+
+    def get_name_value(self):
+        names, values = self.get()
+        return list(zip(names, values))
+
+    def exposition(self) -> str:
+        """Render the Prometheus text exposition format. Gauge callbacks
+        and group ``get_name_value()`` run outside the registry lock."""
+        metrics, groups = self._snapshot()
+        out: List[str] = []
+        for m in metrics:
+            name = _sanitize(m.name)
+            if m.help:
+                out.append("# HELP %s %s" % (name, m.help.replace("\n", " ")))
+            if isinstance(m, Counter):
+                out.append("# TYPE %s counter" % name)
+                out.append("%s %s" % (name, _fmt(m.value)))
+            elif isinstance(m, Gauge):
+                out.append("# TYPE %s gauge" % name)
+                out.append("%s %s" % (name, _fmt(m.value)))
+            elif isinstance(m, Histogram):
+                out.append("# TYPE %s histogram" % name)
+                counts, s, n = m.snapshot()
+                acc = 0
+                for b, c in zip(m.buckets, counts):
+                    acc += c
+                    out.append('%s_bucket{le="%s"} %d' % (name, _fmt(b), acc))
+                out.append('%s_bucket{le="+Inf"} %d' % (name, n))
+                out.append("%s_sum %s" % (name, _fmt(s)))
+                out.append("%s_count %d" % (name, n))
+        for prefix, sid, obj in groups:
+            for n, v in obj.get_name_value():
+                out.append('%s_%s{sid="%d"} %s'
+                           % (_sanitize(prefix), _sanitize(str(n)), sid,
+                              _fmt(v)))
+        return "\n".join(out) + "\n"
+
+    def reset(self):
+        """Drop every metric and group (tests)."""
+        with self._lock:
+            self._metrics.clear()
+            self._groups.clear()
+
+
+def _fmt(v) -> str:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return "NaN"
+    if math.isnan(f):
+        return "NaN"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+#: the process-wide registry (``telemetry.registry``)
+registry = Registry()

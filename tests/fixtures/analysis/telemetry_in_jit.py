@@ -1,0 +1,40 @@
+"""Fixture: telemetry instrumentation inside traced functions. Never
+imported — parsed only.
+
+``instrumented_step`` opens a telemetry span and bumps a registry counter
+inside an ``@jax.jit`` function — both run at trace time only (rule
+``telemetry-in-jit``); ``make_sharded`` does it in a fn passed to
+``shard_map`` by name. ``clean_host_step`` instruments the HOST wrapper
+around the jitted call and must NOT be flagged.
+"""
+import jax
+
+from mxnet_tpu import telemetry
+
+
+@jax.jit
+def instrumented_step(params, grads):
+    with telemetry.span("step", domain="engine"):      # trace-time only
+        new = params - 0.1 * grads
+    telemetry.registry.counter("steps_total")          # trace-time only
+    return new
+
+
+def make_sharded(mesh):
+    def step(params, grads):
+        telemetry.instant("shard_step", domain="engine")  # trace-time only
+        return params - 0.1 * grads
+
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(step, mesh=mesh, in_specs=None, out_specs=None)
+
+
+def clean_host_step(jitted, counter):
+    def run(params, grads):
+        with telemetry.span("host_step", domain="executor"):
+            out = jitted(params, grads)
+        counter.inc()
+        return out
+
+    return run

@@ -62,6 +62,19 @@ def test_impure_jit_fixture_flags_all_purity_rules():
                and "clean_norm" not in f.qualname for f in fs)
 
 
+def test_telemetry_in_jit_fixture_flags_trace_time_instrumentation():
+    fs = analysis.run_analysis(fixture("telemetry_in_jit.py"))
+    hits = [f for f in fs if f.rule == "telemetry-in-jit"]
+    # span + registry access in the decorated fn, instant in the
+    # shard_map'd fn
+    assert {f.qualname.split(":")[-1].split(">")[-1] for f in hits} >= \
+        {"instrumented_step", "step"}
+    assert any("telemetry.span" in f.subject for f in hits)
+    assert any("telemetry.registry.counter" in f.subject for f in hits)
+    # the host-side wrapper (not traced) is NOT flagged
+    assert all("run" not in f.qualname for f in hits)
+
+
 def test_clean_fixture_has_no_findings():
     assert analysis.run_analysis(fixture("clean_locks.py")) == []
 
@@ -90,6 +103,8 @@ def test_cli_fail_on_new_gate():
     assert cli_main(["--root", fixture("undeclared_mutable.py"),
                      "--baseline", "none", "--fail-on-new"]) == 1
     assert cli_main(["--root", fixture("impure_jit.py"),
+                     "--baseline", "none", "--fail-on-new"]) == 1
+    assert cli_main(["--root", fixture("telemetry_in_jit.py"),
                      "--baseline", "none", "--fail-on-new"]) == 1
     # clean fixture: green even with no baseline
     assert cli_main(["--root", fixture("clean_locks.py"),

@@ -426,3 +426,35 @@ def test_per_bucket_latency_gauges():
     assert math.isnan(m.bucket_latency(8))   # never dispatched
     m.reset()
     assert "bucket2_batches" not in dict(m.get_name_value())
+
+
+def test_bucket_latency_empty_and_single_sample_edges():
+    """ISSUE 4 satellite: the nearest-rank percentile math at the edges —
+    a never-dispatched bucket is NaN at every q (and exports no gauges),
+    a single-sample bucket returns that sample at every q, and a
+    zero-latency sample stays 0.0 rather than NaN."""
+    import math
+
+    from mxnet_tpu.serving.metrics import ServingMetrics
+
+    m = ServingMetrics()
+    # empty bucket: NaN for every quantile, including extremes
+    for q in (0, 50, 99, 100):
+        assert math.isnan(m.bucket_latency(2, q=q)), q
+    assert all(not n.startswith("bucket") for n in m.get()[0])
+    # single sample: every quantile is that sample
+    m.record_batch(rows=1, bucket=2, latencies_ms=[7.5])
+    for q in (0, 50, 95, 99, 100):
+        assert m.bucket_latency(2, q=q) == 7.5, q
+    nv = dict(m.get_name_value())
+    assert nv["bucket2_latency_ms_p50"] == 7.5
+    assert nv["bucket2_latency_ms_p99"] == 7.5
+    assert nv["bucket2_batches"] == 1
+    # a batch recorded with an empty latency list counts the batch but
+    # leaves the percentiles NaN (no samples yet)
+    m.record_batch(rows=1, bucket=4, latencies_ms=[])
+    assert math.isnan(m.bucket_latency(4))
+    assert dict(m.get_name_value())["bucket4_batches"] == 1
+    # zero-latency sample is a real 0.0, not a falsy-NaN confusion
+    m.record_batch(rows=1, bucket=8, latencies_ms=[0.0])
+    assert m.bucket_latency(8, q=50) == 0.0
