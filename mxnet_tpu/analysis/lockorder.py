@@ -59,11 +59,17 @@ LOCK_HIERARCHY: Dict[str, int] = {
     "engine._file_lock": 10,
     "engine._engine_lock": 20,
     "engine.NativeEngine._pending_lock": 100,
+    # in-flight gauge table: leaf — the begin/end hooks run inside engine
+    # worker callbacks and must never wait on anything ranked.
+    "engine._inflight_lock": 100,
     # serving: former condition and metrics lock are PEERS — the PR 2 ABBA
     # contract: neither side calls into the other under its own lock.
     "serving.batcher.BatchFormer._cond": 50,
     "serving.metrics.ServingMetrics._lock": 50,
     "serving.bucket_cache.BucketCache._lock": 100,
+    # staging pool buffer table: leaf — fill()/retain() touch only numpy
+    # buffers under it.
+    "serving.staging.StagingPool._lock": 100,
     # kvstore PS client: per-address data locks and the control-channel
     # lock are peers — liveness RPCs must work while data RPCs block.
     "kvstore_server.PSClient._locks[*]": 60,

@@ -331,10 +331,16 @@ def delete_variable(var):
 
 
 def push(fn, const_vars=(), mutable_vars=(), priority=0, name="op"):
+    counted = _inflight_begin(tuple(const_vars) + tuple(mutable_vars))
+    if counted:
+        fn = _wrap_inflight_sync(fn, counted)
     get().push(fn, const_vars, mutable_vars, priority, name)
 
 
 def push_async(fn, const_vars=(), mutable_vars=(), priority=0, name="op"):
+    counted = _inflight_begin(tuple(const_vars) + tuple(mutable_vars))
+    if counted:
+        fn = _wrap_inflight_async(fn, counted)
     get().push_async(fn, const_vars, mutable_vars, priority, name)
 
 
@@ -396,6 +402,87 @@ def fence(vars: Sequence[int], priority: int = 0,
     vs = list(vars)
     get().push(ev.set, const_vars=vs, priority=priority, name=name)
     return Fence(ev, len(vs))
+
+
+# --- per-var in-flight accounting --------------------------------------------
+# Opt-in queued-or-running op counts per engine variable, the signal a
+# load-aware dispatcher needs (serving's least-outstanding-work router reads
+# its replica vars through this): a var registered with track_inflight() has
+# every module-level push/push_async mentioning it counted at push time and
+# released when the op completes (sync: fn returned; async: on_complete
+# fired). Untracked vars pay nothing — one dict probe per push.
+_inflight: Dict[int, int] = {}
+_inflight_lock = threading.Lock()
+
+
+def track_inflight(var: int):
+    """Register ``var`` for in-flight accounting (idempotent)."""
+    with _inflight_lock:
+        _inflight.setdefault(int(var), 0)
+
+
+def untrack_inflight(var: int):
+    """Stop accounting for ``var`` and drop its counter."""
+    with _inflight_lock:
+        _inflight.pop(int(var), None)
+
+
+def var_inflight(var: int) -> int:
+    """Ops queued or running that mention ``var`` (0 if untracked)."""
+    with _inflight_lock:
+        return _inflight.get(int(var), 0)
+
+
+def _inflight_begin(vars) -> tuple:
+    """Count the push against every tracked var; returns the vars counted
+    (empty tuple => nothing tracked, no completion bookkeeping needed)."""
+    if not _inflight:  # racy read is fine: tracking starts before pushing
+        return ()
+    counted = []
+    with _inflight_lock:
+        for v in vars:
+            if v in _inflight:
+                _inflight[v] += 1
+                counted.append(v)
+    return tuple(counted)
+
+
+def _inflight_end(counted: tuple):
+    with _inflight_lock:
+        for v in counted:
+            if v in _inflight:
+                _inflight[v] -= 1
+
+
+def _wrap_inflight_sync(fn, counted):
+    def run():
+        try:
+            fn()
+        finally:
+            _inflight_end(counted)
+    return run
+
+
+def _wrap_inflight_async(fn, counted):
+    def run(on_complete):
+        released = []  # once-guard: the engine's error path may re-complete
+
+        def done():
+            if not released:
+                released.append(1)
+                _inflight_end(counted)
+            on_complete()
+
+        try:
+            fn(done)
+        except BaseException:
+            # the engine completes an op whose fn raised without calling
+            # our done(); release here so the counter can never leak high
+            if not released:
+                released.append(1)
+                _inflight_end(counted)
+            raise
+    return run
 
 
 # --- file-write routing ------------------------------------------------------

@@ -23,9 +23,11 @@ from .batcher import BatchFormer, Request, ServingError
 from .bucket_cache import BucketCache
 from .metrics import ServingBatchEndParam, ServingMetrics
 from .server import InferenceServer, ServingConfig, create_server
+from .staging import StagingPool
+from .tuner import BucketTuner
 
 __all__ = [
     "BatchFormer", "Request", "ServingError", "BucketCache",
     "ServingBatchEndParam", "ServingMetrics", "InferenceServer",
-    "ServingConfig", "create_server",
+    "ServingConfig", "create_server", "StagingPool", "BucketTuner",
 ]

@@ -102,13 +102,23 @@ class BatchFormer:
     """
 
     def __init__(self, max_batch: int, max_delay_ms: float = 2.0,
-                 queue_depth: int = 256, error_hook=None):
+                 queue_depth: int = 256, error_hook=None,
+                 buckets_fn=None, coalesce_fill: float = 0.0):
         if max_batch < 1 or queue_depth < 1:
             raise ServingError("max_batch and queue_depth must be >= 1")
+        if not 0.0 <= float(coalesce_fill) <= 1.0:
+            raise ServingError("coalesce_fill must be in [0, 1]")
         self.max_batch = int(max_batch)
         self.max_delay = float(max_delay_ms) / 1e3
         self.queue_depth = int(queue_depth)
         self._error_hook = error_hook  # called with the code of each failure
+        # cross-bucket coalescing: pack toward the LARGEST ladder bucket
+        # that the queued rows fill to >= coalesce_fill, instead of packing
+        # max_batch rows and letting dispatch pad to whatever bucket the
+        # total lands in. buckets_fn returns the live ladder (it changes
+        # under adaptive tuning); coalesce_fill == 0 disables the policy.
+        self._buckets_fn = buckets_fn
+        self.coalesce_fill = float(coalesce_fill)
         self._q: deque = deque()
         self._rows = 0  # queued rows (cached sum over self._q)
         self._cond = threading.Condition()
@@ -159,11 +169,35 @@ class BatchFormer:
         for r in pending:
             self._fail(r, ServingError(msg, code))
 
+    def _pack_target(self, ladder) -> int:
+        """Row target for the batch about to be packed (caller holds
+        ``_cond``; ``ladder`` was snapshotted BEFORE the lock — the
+        buckets callback reaches into server state and must not run
+        under ``_cond``, the PR 2 ABBA contract). Plain forming packs
+        toward max_batch; with coalescing on, pick the largest ladder
+        bucket the queued rows fill to >= ``coalesce_fill`` — e.g. 5
+        queued single rows on ladder (1, 4, 8) at fill 1.0 dispatch as
+        a FULL bucket-4 batch plus a bucket-1 batch, instead of one
+        5-row batch padded to 8. When no bucket meets the fill bar the
+        window has already expired, so everything queued goes now
+        (max_batch) and dispatch pads as before."""
+        if not ladder or self.coalesce_fill <= 0:
+            return self.max_batch
+        eligible = [b for b in ladder
+                    if self._rows >= self.coalesce_fill * b]
+        return max(eligible) if eligible else self.max_batch
+
     def next_batch(self) -> Optional[List[Request]]:
         """Form the next micro-batch (>= 1 request, <= max_batch rows).
         Returns None when closed and fully drained."""
         while True:
             expired: List[Request] = []
+            # ladder snapshot for coalescing, read OUTSIDE _cond: the
+            # callback reads server state and a stale-by-one-swap ladder
+            # only changes the advisory pack target
+            ladder = self._buckets_fn() if (
+                self._buckets_fn is not None and self.coalesce_fill > 0
+            ) else None
             with self._cond:
                 while not self._q and not self._closed:
                     self._cond.wait()
@@ -177,6 +211,7 @@ class BatchFormer:
                     if remaining <= 0:
                         break
                     self._cond.wait(remaining)
+                target = self._pack_target(ladder)
                 batch, rows, now = [], 0, time.monotonic()
                 while self._q:
                     req = self._q[0]
@@ -185,7 +220,7 @@ class BatchFormer:
                         self._rows -= req.rows
                         expired.append(req)
                         continue
-                    if rows + req.rows > self.max_batch and batch:
+                    if rows + req.rows > target and batch:
                         break  # next micro-batch takes it
                     self._q.popleft()
                     self._rows -= req.rows

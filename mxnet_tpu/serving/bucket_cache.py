@@ -44,6 +44,10 @@ class BucketCache:
         self.hits = 0
         self.misses = 0
         self.compiles = 0
+        # LRU bookkeeping for ladder swaps: logical tick per get(), so
+        # set_ladder can retire the programs traffic stopped touching
+        self._tick = 0
+        self._last_used: Dict[int, int] = {}
         # enroll the base program if it is bound at a bucket batch size
         base_batch = {s[0] for s in base._input_shapes.values()}
         if len(base_batch) == 1 and next(iter(base_batch)) in self.buckets:
@@ -69,9 +73,11 @@ class BucketCache:
     def get(self, bucket: int):
         """The compiled executor for ``bucket`` (compiling on first use)."""
         with self._lock:
+            self._tick += 1
             exe = self._execs.get(bucket)
             if exe is not None:
                 self.hits += 1
+                self._last_used[bucket] = self._tick
                 return exe
             if bucket not in self.buckets:
                 raise ServingError("%d is not a configured bucket (%s)"
@@ -82,7 +88,97 @@ class BucketCache:
             exe = self._base.reshape(shapes, device=self._device)
             self.compiles += 1
             self._execs[bucket] = exe
+            self._last_used[bucket] = self._tick
             return exe
+
+    def acquire(self, rows: int):
+        """``(bucket, executor)`` for ``rows`` against the CURRENT ladder,
+        atomically wrt ``set_ladder`` — the pair a dispatch needs under one
+        lock hold, so a concurrent swap can never retire the chosen bucket
+        between choosing and fetching it (requests must survive retunes)."""
+        with self._lock:
+            self._tick += 1
+            bucket = None
+            for b in self.buckets:
+                if b >= rows:
+                    bucket = b
+                    break
+            if bucket is None:
+                raise ServingError(
+                    "request of %d rows exceeds the largest bucket (%d); "
+                    "raise MXNET_SERVING_BUCKETS or split the request"
+                    % (rows, self.buckets[-1]), "error")
+            exe = self._execs.get(bucket)
+            if exe is not None:
+                self.hits += 1
+                self._last_used[bucket] = self._tick
+                return bucket, exe
+            self.misses += 1
+            shapes = {n: (bucket,) + s
+                      for n, s in self._example_shapes.items()}
+            exe = self._base.reshape(shapes, device=self._device)
+            self.compiles += 1
+            self._execs[bucket] = exe
+            self._last_used[bucket] = self._tick
+            return bucket, exe
+
+    def prepare(self, bucket: int):
+        """Compile-ahead for ``bucket`` without blocking the hot path: the
+        reshape (and its XLA compile) runs OUTSIDE ``_lock`` — reshape is
+        pure wrt the base, it builds a fresh executor sharing params by
+        reference — and the program is enrolled under the lock afterwards,
+        first writer wins. The bucket need not be in the current ladder:
+        this is the warmup half of a ladder swap (``set_ladder``)."""
+        bucket = int(bucket)
+        if bucket < 1:
+            raise ServingError("bucket batch sizes must be >= 1")
+        with self._lock:
+            exe = self._execs.get(bucket)
+            if exe is not None:
+                return exe
+        shapes = {n: (bucket,) + s
+                  for n, s in self._example_shapes.items()}
+        exe = self._base.reshape(shapes, device=self._device)
+        with self._lock:
+            cur = self._execs.get(bucket)
+            if cur is not None:
+                return cur  # lost the race; the duplicate program is dropped
+            self.compiles += 1
+            self._execs[bucket] = exe
+            self._last_used[bucket] = self._tick
+            return exe
+
+    def set_ladder(self, new_buckets: Sequence[int],
+                   budget: Optional[int] = None) -> List[int]:
+        """Swap the bucket ladder atomically; returns the retired buckets.
+
+        The new ladder must keep ``max_batch`` (so every request the
+        server ever admitted still finds a bucket — a swap can never
+        strand an in-flight request). Programs for retired buckets are
+        forgotten LRU-first; a dispatch already holding its executor
+        reference is unaffected — retirement only drops the cache entry,
+        the program dies when its last reference does."""
+        nb = sorted(set(int(b) for b in new_buckets))
+        if not nb:
+            raise ServingError("at least one bucket batch size required")
+        if nb[0] < 1:
+            raise ServingError("bucket batch sizes must be >= 1")
+        with self._lock:
+            if nb[-1] != self.buckets[-1]:
+                raise ServingError(
+                    "ladder swap must preserve max_batch %d (got %s)"
+                    % (self.buckets[-1], nb))
+            self.buckets = nb
+            keep = set(nb)
+            retired = sorted((b for b in self._execs if b not in keep),
+                             key=lambda b: self._last_used.get(b, -1))
+            if budget is not None and len(keep & set(self._execs)) > budget:
+                raise ServingError(
+                    "ladder %s exceeds the program budget %d" % (nb, budget))
+            for b in retired:
+                del self._execs[b]
+                self._last_used.pop(b, None)
+        return retired
 
     def warm(self):
         """Precompile every bucket (trade startup time for tail latency)."""

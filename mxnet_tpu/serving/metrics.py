@@ -48,10 +48,19 @@ class ServingMetrics:
     BUCKET_LATENCY_WINDOW = 1024
 
     def __init__(self, queue_depth_fn: Optional[Callable[[], int]] = None,
-                 cache_stats_fn: Optional[Callable[[], Dict]] = None):
+                 cache_stats_fn: Optional[Callable[[], Dict]] = None,
+                 router_inflight_fn: Optional[
+                     Callable[[], Sequence[int]]] = None,
+                 ladder_version_fn: Optional[Callable[[], int]] = None):
         self._lock = threading.Lock()
         self._queue_depth_fn = queue_depth_fn
         self._cache_stats_fn = cache_stats_fn
+        # router gauges (set by InferenceServer): per-replica in-flight op
+        # counts and the current bucket-ladder version — like the queue
+        # depth gauge these are READ BEFORE _lock in get() (they reach into
+        # engine/server state that must never nest inside _lock)
+        self._router_inflight_fn = router_inflight_fn
+        self._ladder_version_fn = ladder_version_fn
         self.reset()
         # no longer a metrics island: the central registry adopts this
         # instance (weakref'd) so registry.exposition() carries every
@@ -73,11 +82,15 @@ class ServingMetrics:
             # not of the mixed traffic aggregate
             self._bucket_lat: Dict[int, deque] = {}
             self._bucket_batches: Dict[int, int] = {}
+            # request-size histogram (rows -> count): the BucketTuner's
+            # input signal for adaptive ladder derivation
+            self._size_hist: Dict[int, int] = {}
 
     # --- recorders (called by the server/batcher) -------------------------
     def record_submit(self, rows: int = 1):
         with self._lock:
             self.n_submitted += 1
+            self._size_hist[rows] = self._size_hist.get(rows, 0) + 1
 
     def record_error(self, code: str):
         with self._lock:
@@ -102,10 +115,16 @@ class ServingMetrics:
     # --- metric.py-style surface ------------------------------------------
     def get(self):
         """(names, values), EvalMetric.get() shape."""
-        # read the gauge BEFORE taking _lock: depth() takes the former's
+        # read the gauges BEFORE taking _lock: depth() takes the former's
         # condition, and the former calls record_error (which takes _lock)
-        # — nesting them here would order the locks ABBA
+        # — nesting them here would order the locks ABBA; the router gauges
+        # follow the same rule (they take engine._inflight_lock / read
+        # server state)
         depth = self._queue_depth_fn() if self._queue_depth_fn else 0
+        inflight = (list(self._router_inflight_fn())
+                    if self._router_inflight_fn else [])
+        ladder_version = (self._ladder_version_fn()
+                          if self._ladder_version_fn else 0)
         with self._lock:
             dt = max(time.monotonic() - self._t0, 1e-9)
             lat = sorted(self._lat)
@@ -125,6 +144,18 @@ class ServingMetrics:
                 self.n_submitted, self.n_completed, self.n_batches,
                 sum(self.errors.values()),
             ]
+            # padding_waste_pct: the complement of padding_efficiency in
+            # percent — the headline the zero-copy/coalescing/tuning work
+            # drives down (NaN until something dispatched)
+            names.append("padding_waste_pct")
+            values.append(
+                100.0 * (1.0 - self.sum_rows / self.sum_bucket_rows)
+                if self.sum_bucket_rows else float("nan"))
+            names.append("bucket_ladder_version")
+            values.append(ladder_version)
+            for i, n in enumerate(inflight):
+                names.append("router_inflight_replica%d" % i)
+                values.append(n)
             # per-bucket gauges, stable order: bucket<k>_latency_ms_p50/
             # p95/p99 + bucket<k>_batches — the dashboard's SLO series
             for k in sorted(self._bucket_lat):
@@ -154,6 +185,12 @@ class ServingMetrics:
         with self._lock:
             blat = self._bucket_lat.get(bucket)
             return _percentile(sorted(blat), q) if blat else float("nan")
+
+    def request_size_histogram(self) -> Dict[int, int]:
+        """Copy of the rows -> submit-count histogram (the BucketTuner's
+        input signal)."""
+        with self._lock:
+            return dict(self._size_hist)
 
     def error_counts(self) -> Dict[str, int]:
         with self._lock:

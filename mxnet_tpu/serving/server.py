@@ -37,6 +37,8 @@ from .. import telemetry
 from .batcher import BatchFormer, Request, ServingError
 from .bucket_cache import BucketCache
 from .metrics import ServingBatchEndParam, ServingMetrics
+from .staging import StagingPool
+from .tuner import BucketTuner
 
 
 def _env_buckets() -> tuple:
@@ -46,8 +48,8 @@ def _env_buckets() -> tuple:
 
 @dataclass
 class ServingConfig:
-    """Batch-former / queue / replica knobs (env defaults read at
-    construction, docs/env_var.md)."""
+    """Batch-former / queue / replica / hot-path knobs (env defaults read
+    at construction, docs/env_var.md; tuning guide in docs/deployment.md)."""
     buckets: Sequence[int] = field(default_factory=_env_buckets)
     max_delay_ms: float = field(default_factory=lambda: float(
         os.environ.get("MXNET_SERVING_MAX_DELAY_MS", "2.0")))
@@ -59,15 +61,43 @@ class ServingConfig:
         os.environ.get("MXNET_SERVING_REPLICAS", "1")))
     warm: bool = field(default_factory=lambda: bool(int(
         os.environ.get("MXNET_SERVING_WARM", "0"))))
+    # --- hot-path knobs (this PR's tentpole; docs/deployment.md) ---------
+    #: adaptive bucket ladders: a BucketTuner re-derives the ladder from
+    #: the observed request-size histogram every retune_interval batches
+    adaptive: bool = field(default_factory=lambda: bool(int(
+        os.environ.get("MXNET_SERVING_ADAPTIVE", "0"))))
+    #: max compiled programs per replica an adaptive ladder may use
+    program_budget: int = field(default_factory=lambda: int(
+        os.environ.get("MXNET_SERVING_PROGRAM_BUDGET", "8")))
+    #: cross-bucket coalescing: pack toward the largest ladder bucket that
+    #: is >= this percent full (0 disables; 100 = only full buckets)
+    coalesce_fill_pct: float = field(default_factory=lambda: float(
+        os.environ.get("MXNET_SERVING_COALESCE_FILL_PCT", "0")))
+    #: replica routing: "rr" round-robin, or "least_loaded" = fewest
+    #: outstanding engine ops on the replica's var (engine.var_inflight)
+    router: str = field(default_factory=lambda: os.environ.get(
+        "MXNET_SERVING_ROUTER", "rr"))
+    #: assemble batches in reusable per-(replica, bucket) staging buffers
+    #: instead of per-dispatch np.zeros + concatenate
+    zero_copy: bool = field(default_factory=lambda: bool(int(
+        os.environ.get("MXNET_SERVING_ZERO_COPY", "1"))))
+    #: batches between retune passes (adaptive only)
+    retune_interval: int = field(default_factory=lambda: int(
+        os.environ.get("MXNET_SERVING_RETUNE_INTERVAL", "64")))
+    #: min observed requests before the tuner will propose a ladder
+    retune_min_samples: int = field(default_factory=lambda: int(
+        os.environ.get("MXNET_SERVING_RETUNE_MIN_SAMPLES", "64")))
 
 
 class _Replica:
-    __slots__ = ("index", "cache", "var", "dispatched")
+    __slots__ = ("index", "cache", "var", "staging", "dispatched")
 
-    def __init__(self, index: int, cache: BucketCache, var: int):
+    def __init__(self, index: int, cache: BucketCache, var: int,
+                 staging: StagingPool):
         self.index = index
         self.cache = cache
         self.var = var
+        self.staging = staging
         self.dispatched = 0
 
 
@@ -99,7 +129,14 @@ class InferenceServer:
         if devices is not None and len(devices) < n_rep:
             raise ServingError("need %d devices for %d replicas, got %d"
                                % (n_rep, n_rep, len(devices)))
-        smallest = sorted(set(int(b) for b in self.config.buckets))[0]
+        if self.config.router not in ("rr", "least_loaded"):
+            raise ServingError(
+                "MXNET_SERVING_ROUTER must be 'rr' or 'least_loaded', got %r"
+                % (self.config.router,))
+        if not 0.0 <= float(self.config.coalesce_fill_pct) <= 100.0:
+            raise ServingError("coalesce_fill_pct must be in [0, 100]")
+        ladder = tuple(sorted(set(int(b) for b in self.config.buckets)))
+        smallest = ladder[0]
         self._replicas: List[_Replica] = []
         for i in range(n_rep):
             dev = devices[i] if devices is not None else None
@@ -108,11 +145,36 @@ class InferenceServer:
                 {n: (smallest,) + s for n, s in self._example_shapes.items()},
                 dtype=dtype, device=dev)
             cache = BucketCache(base, self.config.buckets, device=dev)
-            self._replicas.append(
-                _Replica(i, cache, engine.new_variable()))
+            var = engine.new_variable()
+            # opt this var into the engine's per-var in-flight accounting:
+            # the least-loaded router reads it, and router_inflight_replica<N>
+            # gauges expose it
+            engine.track_inflight(var)
+            self._replicas.append(_Replica(
+                i, cache, var, StagingPool(self._example_shapes)))
         self._rr = 0
 
-        self.metrics = ServingMetrics(cache_stats_fn=self._cache_stats)
+        # the live ladder (read lock-free by the former/dispatch: tuple
+        # rebind is atomic) + its version, bumped by every adaptive swap
+        self._ladder = ladder
+        self._ladder_version = 0
+        self._tuner: Optional[BucketTuner] = None
+        self._tuner_var: Optional[int] = None
+        if self.config.adaptive:
+            if self.config.program_budget < 1:
+                raise ServingError("program_budget must be >= 1")
+            self._tuner = BucketTuner(
+                max_batch=ladder[-1],
+                program_budget=self.config.program_budget,
+                min_samples=self.config.retune_min_samples)
+            # retunes serialize on a dedicated engine var (background op,
+            # off the dispatch hot path)
+            self._tuner_var = engine.new_variable()
+
+        self.metrics = ServingMetrics(
+            cache_stats_fn=self._cache_stats,
+            router_inflight_fn=self._router_inflight,
+            ladder_version_fn=lambda: self._ladder_version)
         self._former = self._make_former()
         self._nbatch = 0
         self._thread: Optional[threading.Thread] = None
@@ -126,7 +188,9 @@ class InferenceServer:
             max_batch=max(self.config.buckets),
             max_delay_ms=self.config.max_delay_ms,
             queue_depth=self.config.queue_depth,
-            error_hook=self.metrics.record_error)
+            error_hook=self.metrics.record_error,
+            buckets_fn=lambda: self._ladder,
+            coalesce_fill=self.config.coalesce_fill_pct / 100.0)
         self.metrics._queue_depth_fn = former.depth
         return former
 
@@ -138,6 +202,12 @@ class InferenceServer:
             for k in agg:
                 agg[k] += s[k]
         return agg
+
+    def _router_inflight(self) -> List[int]:
+        """Per-replica outstanding engine-op counts (the router's signal
+        and the router_inflight_replica<N> gauges)."""
+        return [engine.var_inflight(rep.var) if rep.var is not None else 0
+                for rep in self._replicas]
 
     # --- lifecycle --------------------------------------------------------
     def start(self) -> "InferenceServer":
@@ -152,6 +222,9 @@ class InferenceServer:
             for rep in self._replicas:
                 if rep.var is None:
                     rep.var = engine.new_variable()
+                    engine.track_inflight(rep.var)
+            if self._tuner is not None and self._tuner_var is None:
+                self._tuner_var = engine.new_variable()
         self._started = True
         self._thread = threading.Thread(target=self._former_loop,
                                         daemon=True, name="serving-former")
@@ -174,8 +247,13 @@ class InferenceServer:
         self._thread.join()
         for rep in self._replicas:
             engine.wait_for_var(rep.var)
+            engine.untrack_inflight(rep.var)
             engine.delete_variable(rep.var)
             rep.var = None
+        if self._tuner_var is not None:
+            engine.wait_for_var(self._tuner_var)
+            engine.delete_variable(self._tuner_var)
+            self._tuner_var = None
         self._started = False
 
     def __enter__(self):
@@ -253,8 +331,7 @@ class InferenceServer:
                     telemetry.complete("serving.queued", domain="serving",
                                        start_ns=int(r.submitted * 1e9),
                                        rows=r.rows)
-            rep = self._replicas[self._rr % len(self._replicas)]
-            self._rr += 1
+            rep = self._pick_replica()
             self._nbatch += 1
             nbatch = self._nbatch
             engine.push_async(
@@ -262,6 +339,82 @@ class InferenceServer:
                     self._dispatch(batch, rep, nbatch, done),
                 mutable_vars=[rep.var],
                 name="serving_dispatch_r%d" % rep.index)
+            if (self._tuner is not None and self.config.retune_interval > 0
+                    and nbatch % self.config.retune_interval == 0):
+                self._push_retune()
+
+    def _pick_replica(self) -> _Replica:
+        """Routing policy. ``rr``: classic round-robin. ``least_loaded``:
+        the replica with the fewest outstanding engine ops on its var
+        (queued + running dispatches, engine.var_inflight) — a stalled
+        replica keeps absorbing nothing while healthy ones drain the
+        queue, which bounds p99 where round-robin lets one slow replica
+        inflate it. Round-robin start index breaks ties so equal-load
+        replicas still rotate."""
+        reps = self._replicas
+        start = self._rr % len(reps)
+        self._rr += 1
+        if self.config.router != "least_loaded" or len(reps) == 1:
+            return reps[start]
+        best, best_load = None, None
+        for i in range(len(reps)):
+            rep = reps[(start + i) % len(reps)]
+            load = engine.var_inflight(rep.var)
+            if best_load is None or load < best_load:
+                best, best_load = rep, load
+        return best
+
+    # --- adaptive ladder retune -------------------------------------------
+    def _push_retune(self):
+        engine.push(self._retune_op, mutable_vars=[self._tuner_var],
+                    name="serving_retune")
+
+    def retune_now(self, wait: bool = True):
+        """Run one tuner pass now (bench/tests; the periodic path pushes
+        the same op every ``retune_interval`` batches). Serialized on the
+        tuner engine var like every retune."""
+        if self._tuner is None:
+            raise ServingError(
+                "adaptive tuning is disabled (ServingConfig.adaptive)")
+        if self._tuner_var is None:
+            raise ServingError("server is stopped", "shutdown")
+        self._push_retune()
+        if wait:
+            engine.fence([self._tuner_var]).wait()
+
+    def _retune_op(self):
+        """One tuner pass (runs on an engine worker, off the hot path):
+        propose a ladder from the observed size histogram; if it clears
+        the hysteresis bar, compile-ahead-warm every new bucket, THEN swap
+        each replica's ladder atomically and retire old programs LRU. The
+        former/dispatch never blocks on any of this — they read the old
+        ladder until the rebind, and acquire() makes choose+fetch atomic
+        against the swap, so no in-flight request can fail."""
+        try:
+            ladder = self._tuner.propose(
+                self.metrics.request_size_histogram(), self._ladder)
+            if ladder is None:
+                return
+            with telemetry.span("serving.retune", domain="serving",
+                                ladder=str(ladder)):
+                for rep in self._replicas:
+                    for b in ladder:
+                        rep.cache.prepare(b)  # warm BEFORE the swap
+                for rep in self._replicas:
+                    rep.cache.set_ladder(
+                        ladder, budget=self._tuner.program_budget)
+                    rep.staging.retain(ladder)
+                self._ladder = tuple(ladder)
+                self._ladder_version += 1
+            telemetry.instant("serving.ladder_swap", domain="serving",
+                              version=self._ladder_version,
+                              ladder=str(ladder))
+        except BaseException:
+            # a failed retune must never take the serving path down;
+            # traffic continues on the current ladder
+            logging.getLogger("mxnet_tpu").exception(
+                "serving ladder retune failed (keeping ladder %s)",
+                self._ladder)
 
     def _dispatch(self, batch: List[Request], rep: _Replica, nbatch: int,
                   on_complete: Callable[[], None]):
@@ -272,7 +425,9 @@ class InferenceServer:
         sp.__enter__()
         try:
             rows = sum(r.rows for r in batch)
-            bucket = rep.cache.bucket_for(rows)
+            # choose-and-fetch under one cache lock hold: atomic against a
+            # concurrent adaptive ladder swap
+            bucket, exe = rep.cache.acquire(rows)
             if telemetry.enabled("serving"):
                 now = time.monotonic()
                 margins = [(r.deadline - now) * 1e3 for r in batch
@@ -280,18 +435,25 @@ class InferenceServer:
                 sp.annotate(bucket=bucket, rows=rows,
                             deadline_margin_ms=(round(min(margins), 3)
                                                 if margins else None))
-            exe = rep.cache.get(bucket)
             with telemetry.span("serving.pad", domain="serving",
                                 bucket=bucket, rows=rows):
-                feed = {}
-                for name in self._input_names:
-                    cat = np.concatenate(
-                        [r.inputs[name] for r in batch], axis=0)
-                    if bucket > rows:
-                        pad = np.zeros((bucket - rows,) + cat.shape[1:],
-                                       cat.dtype)
-                        cat = np.concatenate([cat, pad], axis=0)
-                    feed[name] = cat
+                if self.config.zero_copy:
+                    # rows land directly in the replica's reusable staging
+                    # buffer (safe: dispatches to this replica serialize
+                    # on its engine var, and forward copies host->device
+                    # before returning)
+                    feed = rep.staging.fill(batch, bucket,
+                                            self._input_names)
+                else:
+                    feed = {}
+                    for name in self._input_names:
+                        cat = np.concatenate(
+                            [r.inputs[name] for r in batch], axis=0)
+                        if bucket > rows:
+                            pad = np.zeros(
+                                (bucket - rows,) + cat.shape[1:], cat.dtype)
+                            cat = np.concatenate([cat, pad], axis=0)
+                        feed[name] = cat
             with telemetry.span("serving.forward", domain="serving",
                                 bucket=bucket):
                 outs = [o.asnumpy() for o in exe.forward(**feed)]
@@ -343,6 +505,19 @@ class InferenceServer:
 
     def replica_dispatch_counts(self) -> List[int]:
         return [rep.dispatched for rep in self._replicas]
+
+    def current_ladder(self) -> tuple:
+        """The live bucket ladder (changes under adaptive tuning)."""
+        return self._ladder
+
+    @property
+    def ladder_version(self) -> int:
+        """0 for the static ladder; +1 per adaptive swap."""
+        return self._ladder_version
+
+    def router_inflight(self) -> List[int]:
+        """Per-replica outstanding engine-op counts (router's live view)."""
+        return self._router_inflight()
 
 
 def create_server(prefix: str, epoch: int, example_shapes: Dict[str, tuple],
