@@ -544,6 +544,26 @@ def run_serving_config():
         ladder_b = list(srv_b.current_ladder())
         version_b = srv_b.ladder_version
 
+    # --- capture arm: B + engine capture/replay of the dispatch ----------
+    # each (replica, bucket) dispatch sequence is length 1, so the QPS
+    # delta is small by construction — this arm exercises the capture API
+    # under real concurrent traffic + a ladder retune; the >=3x host-
+    # overhead claim is carried by the engine microbench (BENCH_MODEL=
+    # engine / run_engine_config)
+    cfg_c = serving.ServingConfig(
+        buckets=buckets, replicas=n_replicas, warm=True,
+        router="least_loaded", adaptive=True, zero_copy=True,
+        max_delay_ms=2.0,
+        coalesce_fill_pct=100.0, program_budget=4,
+        retune_min_samples=32, retune_interval=0, capture=True)
+    srv_c = mk(cfg_c)
+    with srv_c:
+        _serving_burst(srv_c, in_dim, n_requests // 2, n_threads, mix)
+        srv_c.retune_now(wait=True)
+        c = best_burst(srv_c)
+        replays_c = sum(cs.replays for rep in srv_c._replicas
+                        for cs in rep.captures.values())
+
     telemetry_rec = {
         "spans_off_qps": round(b["_qps"], 1),
         "spans_on_qps": round(b_on["_qps"], 1),
@@ -585,9 +605,104 @@ def run_serving_config():
                    "program_budget": 4},
         "baseline_config": {"adaptive": False, "router": "rr",
                             "zero_copy": False, "coalesce_fill_pct": 0.0},
-        "client_errors": b["_errors"] + a["_errors"],
+        "client_errors": b["_errors"] + a["_errors"] + c["_errors"],
         "telemetry": telemetry_rec,
+        "capture": {
+            "qps": round(c["_qps"], 1),
+            "vs_adaptive": round(c["_qps"] / b["_qps"], 3)
+                           if b["_qps"] else None,
+            "replays": replays_c,
+            "config": "B + ServingConfig.capture (MXNET_ENGINE_CAPTURE)",
+        },
         "model": "MLP %d-%d-%d softmax" % (in_dim, hidden, classes),
+    }
+
+
+def run_engine_config():
+    """Dispatch-overhead microbench (BENCH_MODEL=engine): host-side engine
+    time per op, eager push vs captured/replayed submission, over a
+    64-op/8-var chain with real RAW dependencies.
+
+    Methodology: time ONLY the push loops — the replay's target is the
+    per-op Python scheduling cost (_dedup, pending-table lock, ctypes
+    marshalling, native queue insert), not op execution, so the queue is
+    drained by an engine fence OUTSIDE the timed region. Median of
+    BENCH_ENGINE_REPEATS timed blocks of BENCH_ENGINE_ITERS iterations.
+    value = eager_us_per_op / replay_us_per_op (the >=3x gate);
+    vs_baseline = value / 3.0 so >=1.0 passes."""
+    from mxnet_tpu import engine
+
+    n_ops = int(os.environ.get("BENCH_ENGINE_OPS", "64"))
+    n_vars = 8
+    iters = int(os.environ.get("BENCH_ENGINE_ITERS", "50"))
+    repeats = max(1, int(os.environ.get("BENCH_ENGINE_REPEATS", "5")))
+    vars_ = tuple(engine.new_variable() for _ in range(n_vars))
+    # op i writes var i%8 and reads var (i+1)%8: a dense dependency
+    # braid, so the eager arm pays real scheduler work per push
+    sigs = tuple(((vars_[(i + 1) % n_vars],), (vars_[i % n_vars],),
+                  "bench_op%d" % i) for i in range(n_ops))
+
+    def nop():
+        pass
+
+    def eager_iter():
+        for c, m, nm in sigs:
+            engine.push(nop, const_vars=c, mutable_vars=m, name=nm)
+
+    def drain():
+        engine.fence(list(vars_), name="bench_engine_drain").wait(60)
+
+    eager_iter()
+    drain()
+    eager_times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            eager_iter()
+        eager_times.append(time.perf_counter() - t0)
+        drain()
+    eager_per_op = statistics.median(eager_times) / (iters * n_ops)
+
+    cs = engine.CapturedSequence(name="bench_engine")
+
+    def cap_iter():
+        cs.begin_step()
+        for c, m, nm in sigs:
+            cs.push(nop, const_vars=c, mutable_vars=m, name=nm)
+        cs.end_step()
+
+    for _ in range(cs.warmup):
+        cap_iter()
+    drain()
+    assert cs.state == "ready", \
+        "bench bug: capture did not stabilize (%s)" % cs.state
+    replay_times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            cap_iter()
+        replay_times.append(time.perf_counter() - t0)
+        drain()
+    replay_per_op = statistics.median(replay_times) / (iters * n_ops)
+    assert cs.replays >= repeats * iters and cs.bails == 0, \
+        "bench bug: replay arm ran eagerly (%d replays, %d bails)" \
+        % (cs.replays, cs.bails)
+    speedup = eager_per_op / replay_per_op
+    return {
+        "metric": "engine_dispatch_overhead",
+        "value": round(speedup, 2),
+        "unit": "x_eager_host_us_per_op_over_replay",
+        "vs_baseline": round(speedup / 3.0, 3),  # >=1.0 <=> the 3x gate
+        "eager_us_per_op": round(eager_per_op * 1e6, 3),
+        "replay_us_per_op": round(replay_per_op * 1e6, 3),
+        "eager_pushes_per_sec": round(1.0 / eager_per_op),
+        "replay_pushes_per_sec": round(1.0 / replay_per_op),
+        "ops_per_sequence": n_ops,
+        "n_vars": n_vars,
+        "iters": iters,
+        "repeats": repeats,
+        "replays": cs.replays,
+        "engine": type(engine.get()).__name__,
     }
 
 
@@ -603,6 +718,9 @@ def _main():
     which = os.environ.get("BENCH_MODEL", "both")
     if which == "serving":
         _emit(run_serving_config())
+        return
+    if which == "engine":
+        _emit(run_engine_config())
         return
     if os.environ.get("BENCH_LM_SWEEP"):
         # transformer (bs, seq) MFU table (docs/perf.md); one JSON line

@@ -61,7 +61,8 @@ echo "== stage 7: static analysis (lock-order / engine-discipline / trace-purity
 JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis --fail-on-new
 # Self-check: the known-bad fixtures must trip the gate (a silently
 # lobotomized analyzer would otherwise pass CI forever).
-for bad in abba_deadlock undeclared_mutable impure_jit telemetry_in_jit; do
+for bad in abba_deadlock undeclared_mutable impure_jit telemetry_in_jit \
+        capture_unstable; do
     if JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis \
             --root "tests/fixtures/analysis/${bad}.py" \
             --baseline none --fail-on-new >/dev/null 2>&1; then

@@ -62,6 +62,9 @@ LOCK_HIERARCHY: Dict[str, int] = {
     # in-flight gauge table: leaf — the begin/end hooks run inside engine
     # worker callbacks and must never wait on anything ranked.
     "engine._inflight_lock": 100,
+    # capture/replay state machine: leaf — state flips only; pushes,
+    # callbacks, and logging all happen outside the hold.
+    "engine.CapturedSequence._lock": 100,
     # serving: former condition and metrics lock are PEERS — the PR 2 ABBA
     # contract: neither side calls into the other under its own lock.
     "serving.batcher.BatchFormer._cond": 50,

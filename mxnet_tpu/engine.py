@@ -28,10 +28,11 @@ from __future__ import annotations
 
 import ctypes
 import json
+import logging
 import os
 import threading
 import traceback
-from typing import Callable, Dict, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from . import telemetry as _telemetry
 from .base import MXNetError
@@ -402,6 +403,340 @@ def fence(vars: Sequence[int], priority: int = 0,
     vs = list(vars)
     get().push(ev.set, const_vars=vs, priority=priority, name=name)
     return Fence(ev, len(vs))
+
+
+# --- capture/replay of steady-state dispatch sequences -----------------------
+# PyGraph-style (PAPERS.md): the per-op host cost of dynamic dispatch —
+# _dedup, the pending-table lock, the ctypes marshalling, the native
+# scheduler walk — is paid once during a short warmup, then the whole
+# sequence replays as ONE engine submission whose internal ordering comes
+# from a precomputed edge list.
+
+_log = logging.getLogger("mxnet_tpu")
+
+
+def capture_enabled() -> bool:
+    """True when ``MXNET_ENGINE_CAPTURE`` opts steady-state callers
+    (``Module.fit_step``, serving dispatch) into capture/replay. Read at
+    point of use so tests and dryruns can flip it mid-process."""
+    return os.environ.get("MXNET_ENGINE_CAPTURE", "0").lower() \
+        not in ("0", "", "false", "off")
+
+
+def capture_warmup() -> int:
+    """Warmup iterations before a sequence is eligible to replay
+    (``MXNET_ENGINE_CAPTURE_WARMUP``, default 3, floor 2 — stability is
+    meaningless with a single observation)."""
+    try:
+        n = int(os.environ.get("MXNET_ENGINE_CAPTURE_WARMUP", "3"))
+    except ValueError:
+        n = 3
+    return max(2, n)
+
+
+class CapturedSequence:
+    """Record a steady-state push sequence once, replay it with near-zero
+    host overhead.
+
+    Protocol — the owning thread brackets each iteration::
+
+        cs = engine.CapturedSequence(name="fit_step")
+        for batch in loader:
+            cs.begin_step()
+            cs.push(load_fn, mutable_vars=[data_var], name="load")
+            cs.push(step_fn, const_vars=[data_var],
+                    mutable_vars=[step_var], name="step")
+            cs.end_step()
+
+    For the first ``warmup`` iterations every push forwards eagerly
+    through the module-level :func:`push`/:func:`push_async` (so behavior
+    is identical to not capturing) while the ``(is_async, name, priority,
+    const_vars, mutable_vars)`` signature stream is recorded. If all
+    warmup iterations produced the SAME signature stream, the sequence
+    compiles: per-op ``_dedup`` runs once, RAW/WAR/WAW edges between the
+    recorded ops are resolved into a static dependency list, and the
+    union of all vars becomes the replay submission's var set. If the
+    stream was unstable (different ops or different var topology across
+    iterations) the sequence **bails to eager** with a logged reason and
+    stays eager until :meth:`invalidate` is called.
+
+    A compiled iteration is submitted by ``end_step()`` as ONE
+    module-level :func:`push_async` — so per-var in-flight accounting
+    counts the replay's vars exactly once per replay, :func:`fence` over
+    any of the union vars orders after the whole replay (including its
+    async children's ``on_complete``), and file vars in the recorded
+    signatures keep their write ordering. Inside the replay op the
+    recorded ops run in recorded order on one engine worker, waiting only
+    on precomputed edges to async predecessors — no per-op ``_dedup``, no
+    scheduler-queue lock, no ctypes marshalling.
+
+    If a replayed iteration deviates from the recording (different op at
+    slot i, or fewer/more ops), the already-matched prefix is flushed
+    eagerly in order, the rest of the iteration runs eagerly, and the
+    sequence returns to capturing — a mismatch never loses or reorders
+    an op.
+
+    Threading: one thread drives ``begin_step``/``push``/``end_step``;
+    :meth:`invalidate` may be called from any thread (e.g. a retune op on
+    an engine worker) — it sets a flag consumed at the next
+    ``begin_step``. ``_lock`` is a declared leaf (rank 100): no call
+    leaves the package while it is held.
+    """
+
+    def __init__(self, name: str = "seq", warmup: Optional[int] = None):
+        self._name = name
+        self._warmup = max(2, warmup) if warmup is not None \
+            else capture_warmup()
+        self._lock = threading.Lock()
+        # state: "capture" (recording + eager), "ready" (replaying),
+        # "flush" (mid-step after a mismatch: eager, not recording),
+        # "eager" (bailed on unstable warmup: eager until invalidate())
+        self._state = "capture"
+        self._iters: List[list] = []     # signature stream per warmup iter
+        self._cur: Optional[list] = None
+        self._ops: Optional[List[tuple]] = None  # [(sig, deps), ...]
+        self._union: Tuple[tuple, tuple] = ((), ())
+        self._slots: List[Callable] = []
+        self._invalid_reason: Optional[str] = None
+        self.replays = 0
+        self.bails = 0
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def warmup(self) -> int:
+        return self._warmup
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def invalidate(self, reason: str):
+        """Discard the recording at the next ``begin_step`` (thread-safe;
+        an already-submitted replay is unaffected — its vars and closures
+        were frozen at submission)."""
+        with self._lock:
+            if self._invalid_reason is None:
+                self._invalid_reason = reason
+
+    # -- step bracketing ------------------------------------------------
+
+    def begin_step(self):
+        reason = None
+        with self._lock:
+            if self._invalid_reason is not None:
+                reason = self._invalid_reason
+                self._invalid_reason = None
+                self._reset_locked()
+            elif self._state == "flush":  # caller skipped end_step
+                self._reset_locked()
+            if self._state == "ready":
+                self._slots = []
+            elif self._state == "capture":
+                self._cur = []
+        if reason is not None:
+            _log.info("engine capture '%s': invalidated (%s), recapturing",
+                      self._name, reason)
+
+    def end_step(self):
+        st = self._state
+        if st == "ready":
+            with self._lock:
+                slots, self._slots = self._slots, []
+            if len(slots) != len(self._ops):
+                self._flush_eager(
+                    slots, "iteration ended after %d of %d recorded ops"
+                    % (len(slots), len(self._ops)))
+                with self._lock:
+                    self._reset_locked()
+                return
+            self._submit_replay(slots)
+            self.replays += 1
+        elif st == "capture":
+            cur, self._cur = self._cur, None
+            if cur is not None:
+                self._iters.append(cur)
+                if len(self._iters) >= self._warmup:
+                    self._compile()
+        elif st == "flush":
+            with self._lock:
+                self._reset_locked()
+
+    # -- pushes ---------------------------------------------------------
+
+    def push(self, fn: Callable[[], None], const_vars: Sequence[int] = (),
+             mutable_vars: Sequence[int] = (), priority: int = 0,
+             name: str = "op"):
+        """Sync push routed through the capture state machine."""
+        self._push(False, fn, const_vars, mutable_vars, priority, name)
+
+    def push_async(self, fn: Callable[[Callable[[], None]], None],
+                   const_vars: Sequence[int] = (),
+                   mutable_vars: Sequence[int] = (), priority: int = 0,
+                   name: str = "op"):
+        """Async push routed through the capture state machine."""
+        self._push(True, fn, const_vars, mutable_vars, priority, name)
+
+    def _push(self, is_async, fn, const_vars, mutable_vars, priority, name):
+        sig = (is_async, name, int(priority),
+               tuple(const_vars), tuple(mutable_vars))
+        st = self._state
+        if st == "ready":
+            i = len(self._slots)
+            if i < len(self._ops) and self._ops[i][0] == sig:
+                self._slots.append(fn)
+                return
+            with self._lock:
+                slots, self._slots = self._slots, []
+                self._state = "flush"
+            self._flush_eager(
+                slots, "op %d is %r, recorded %r" % (
+                    i, name,
+                    self._ops[i][0][1] if i < len(self._ops) else "<end>"))
+        elif st == "capture":
+            if self._cur is not None:
+                self._cur.append(sig)
+        # capture warmup, flush, and bailed-eager all forward eagerly
+        if is_async:
+            push_async(fn, const_vars, mutable_vars, priority, name)
+        else:
+            push(fn, const_vars, mutable_vars, priority, name)
+
+    # -- internals ------------------------------------------------------
+
+    def _reset_locked(self):
+        self._state = "capture"
+        self._iters = []
+        self._cur = None
+        self._ops = None
+        self._slots = []
+
+    def _flush_eager(self, slots, why):
+        """Replay deviated: run the already-matched prefix eagerly, in
+        recorded order, so nothing is lost or reordered."""
+        self.bails += 1
+        _log.info("engine capture '%s': replay mismatch (%s); flushing %d "
+                  "op(s) eagerly and recapturing", self._name, why,
+                  len(slots))
+        for j, fn in enumerate(slots):
+            s_async, s_name, s_pri, s_const, s_mut = self._ops[j][0]
+            if s_async:
+                push_async(fn, s_const, s_mut, s_pri, s_name)
+            else:
+                push(fn, s_const, s_mut, s_pri, s_name)
+
+    def _compile(self):
+        """All warmup iterations observed: verify stability, resolve the
+        dependency edges once, or bail to eager."""
+        first = self._iters[0]
+        if not first:
+            self._iters = []  # empty steps: nothing to replay, keep looking
+            return
+        for k, it in enumerate(self._iters[1:], 1):
+            if it != first:
+                with self._lock:
+                    self._state = "eager"
+                    self._iters = []
+                self.bails += 1
+                _log.info(
+                    "engine capture '%s': unstable across warmup (iteration "
+                    "%d has %d op(s), first had %d; or var topology "
+                    "changed) — staying eager until invalidated",
+                    self._name, k, len(it), len(first))
+                return
+        ops = []
+        last_writer: Dict[int, int] = {}
+        readers_since: Dict[int, list] = {}
+        union_mut: Dict[int, None] = {}
+        union_const: Dict[int, None] = {}
+        for i, sig in enumerate(first):
+            const, mut = _dedup(sig[3], sig[4])  # per-op _dedup, done ONCE
+            deps = set()
+            for v in const:
+                if v in last_writer:
+                    deps.add(last_writer[v])            # RAW
+            for v in mut:
+                if v in last_writer:
+                    deps.add(last_writer[v])            # WAW
+                deps.update(readers_since.get(v, ()))   # WAR
+            for v in const:
+                readers_since.setdefault(v, []).append(i)
+                union_const.setdefault(v)
+            for v in mut:
+                last_writer[v] = i
+                readers_since[v] = []
+                union_mut.setdefault(v)
+            ops.append((sig, tuple(sorted(deps))))
+        u_mut = tuple(union_mut)
+        u_const = tuple(v for v in union_const if v not in union_mut)
+        with self._lock:
+            self._ops = ops
+            self._union = (u_const, u_mut)
+            self._iters = []
+            self._state = "ready"
+        _log.info("engine capture '%s': captured %d op(s) over %d vars, "
+                  "replaying", self._name, len(ops),
+                  len(u_const) + len(u_mut))
+
+    def _submit_replay(self, slots):
+        """Submit one iteration as a single module-level push_async. The
+        union var set makes fence()/in-flight/file-var semantics hold for
+        the whole sequence; inside, ops run in recorded order waiting
+        only on precomputed edges to async predecessors."""
+        ops = self._ops
+        seq_name = self._name
+
+        def replay(on_complete, _slots=slots, _ops=ops):
+            on_engine = _telemetry.enabled("engine")
+            tok = _telemetry.begin("engine.replay", domain="engine",
+                                   ops=len(_ops), sequence=seq_name) \
+                if on_engine else None
+            events: List[Optional[threading.Event]] = [None] * len(_ops)
+            for i, (sig, deps) in enumerate(_ops):
+                is_async, opname = sig[0], sig[1]
+                for d in deps:
+                    ev = events[d]
+                    if ev is not None:  # sync deps completed in program order
+                        ev.wait()
+                fn = _slots[i]
+                try:
+                    if is_async:
+                        done_ev = threading.Event()
+                        events[i] = done_ev
+                        if on_engine:
+                            optok = _telemetry.begin(opname, domain="engine",
+                                                     replay=True)
+
+                            def done(_ev=done_ev, _t=optok):
+                                _telemetry.end(_t)
+                                _ev.set()
+                        else:
+                            done = done_ev.set
+                        fn(done)
+                    else:
+                        if on_engine:
+                            with _telemetry.span(opname, domain="engine",
+                                                 replay=True):
+                                fn()
+                        else:
+                            fn()
+                except Exception:  # mirror _dispatch: never escape the op
+                    traceback.print_exc()
+                    if events[i] is not None:
+                        events[i].set()
+            # the submission completes only when every child has: that is
+            # what keeps fence()/in-flight release correct under replay
+            for ev in events:
+                if ev is not None:
+                    ev.wait()
+            if tok is not None:
+                _telemetry.end(tok)
+            on_complete()
+
+        push_async(replay, self._union[0], self._union[1],
+                   name="replay:%s" % seq_name)
 
 
 # --- per-var in-flight accounting --------------------------------------------

@@ -577,3 +577,57 @@ class Executor:
 
     def print_summary(self):
         return self._symbol.debug_str()
+
+
+class CapturedTrainStep:
+    """Engine capture/replay harness for a steady-state train step
+    (MXNET_ENGINE_CAPTURE; see engine.CapturedSequence).
+
+    Each step is two engine ops — ``fit.load_data`` writes the executor's
+    data buffers (mutable ``data_var``) and ``fit.step`` reads them and
+    advances the donated params/states (const ``data_var``, mutable
+    ``step_var``). The WAR edge data_var gives the replayed graph makes
+    step N's read precede load N+1's write, so consecutive fit_steps
+    pipeline safely through one submission per step after warmup.
+
+    ``fence()`` is the happens-before edge readers of the fused state
+    need (param writeback, metric update, output reads); callers must
+    ``close()`` before dropping the harness so the engine vars retire.
+    """
+
+    def __init__(self, name: str = "train_step"):
+        from . import engine
+        self._engine = engine
+        self.data_var: Optional[int] = engine.new_variable()
+        self.step_var: Optional[int] = engine.new_variable()
+        self.seq = engine.CapturedSequence(name=name)
+
+    def step(self, load_fn, step_fn):
+        """Run one iteration through the capture state machine: eager
+        during warmup, one replayed submission once the sequence is
+        stable."""
+        seq = self.seq
+        seq.begin_step()
+        seq.push(load_fn, mutable_vars=(self.data_var,),
+                 name="fit.load_data")
+        seq.push(step_fn, const_vars=(self.data_var,),
+                 mutable_vars=(self.step_var,), name="fit.step")
+        seq.end_step()
+
+    def invalidate(self, reason: str):
+        self.seq.invalidate(reason)
+
+    def fence(self):
+        """Order every pushed/replayed step before the caller proceeds."""
+        if self.data_var is not None:
+            self._engine.fence([self.data_var, self.step_var],
+                               name="fit.capture_fence").wait()
+
+    def close(self):
+        """Drain outstanding steps and retire the engine vars."""
+        if self.data_var is None:
+            return
+        self.fence()
+        self._engine.delete_variable(self.data_var)
+        self._engine.delete_variable(self.step_var)
+        self.data_var = self.step_var = None

@@ -160,6 +160,9 @@ class Module(BaseModule):
         # stale so explicitly-set parameters take effect on the next step
         # (the compiled step program is kept — no per-epoch recompile)
         self._sync_fused_to_exec()
+        fs = self._fused_fit
+        if isinstance(fs, dict) and fs.get("capture") is not None:
+            fs["capture"].invalidate("param-set")
         self._fused_refresh = True
 
         if self._arg_params is None:
@@ -258,6 +261,7 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        self._close_fused_capture("rebind")
         if self._fused_fit:
             # force_rebind discards the fused state: flush its deferred
             # lockstep counts first or _index_update_count permanently
@@ -312,6 +316,7 @@ class Module(BaseModule):
 
         self.optimizer_initialized = True
         self._sync_fused_to_exec()
+        self._close_fused_capture("optimizer re-init")
         self._fused_fit = None  # re-evaluate fused eligibility
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
@@ -365,10 +370,12 @@ class Module(BaseModule):
             )
 
     def update_metric(self, eval_metric, labels):
+        self._capture_fence()  # outputs are set on an engine worker
         self._exec_group.update_metric(eval_metric, labels)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
+        self._capture_fence()  # outputs are set on an engine worker
         return self._exec_group.get_outputs(merge_multi_context=merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
@@ -400,6 +407,7 @@ class Module(BaseModule):
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
         self._sync_fused_to_exec()  # keep fused params; pre-load states moot
+        self._close_fused_capture("optimizer state load")
         self._fused_fit = None      # rebuild so loaded states are picked up
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
@@ -425,6 +433,7 @@ class Module(BaseModule):
             # mutated mid-training: the compiled step traced the old value —
             # sync state out and rebuild (same contract as Updater.update_all)
             self._sync_fused_to_exec()
+            self._close_fused_capture("hyperparameter change")
             self._fused_fit = None
             fs = self._fused_fit_state()
         if fs is None:
@@ -477,13 +486,74 @@ class Module(BaseModule):
                                                  sharding=lw_sh)
             fs["lw_fp"] = fp
         lr_arr, wd_arr = fs["lw"][1], fs["lw"][2]
-        # place the batch with the group's device/sharding logic; the step
-        # then reads the executor's data buffers (empty feed dict).
-        self._exec_group._load_data(data_batch)
-        _, fs["params"], fs["states"] = fs["step"](
-            fs["params"], fs["states"], {}, lr_arr, wd_arr)
+        cap = self._fit_capture(fs, data_batch)
+        if cap is not None:
+            # engine capture/replay (MXNET_ENGINE_CAPTURE): the two host
+            # ops of a steady-state step ride a CapturedSequence — eager
+            # for the warmup steps, then ONE engine submission per step.
+            # The closures read fs at RUN time, so each replayed step
+            # consumes the params/states its predecessor threaded through.
+            exec_group = self._exec_group
+
+            def load(_db=data_batch):
+                exec_group._load_data(_db)
+
+            def stepped(_lr=lr_arr, _wd=wd_arr):
+                _, fs["params"], fs["states"] = fs["step"](
+                    fs["params"], fs["states"], {}, _lr, _wd)
+
+            cap.step(load, stepped)
+        else:
+            # place the batch with the group's device/sharding logic; the
+            # step then reads the executor's data buffers (empty feed dict).
+            self._exec_group._load_data(data_batch)
+            _, fs["params"], fs["states"] = fs["step"](
+                fs["params"], fs["states"], {}, lr_arr, wd_arr)
         self._params_dirty = True
         self._fused_dirty = True
+
+    def _fit_capture(self, fs, data_batch):
+        """The fused path's CapturedTrainStep, or None when
+        MXNET_ENGINE_CAPTURE is off. Auto-invalidates on reshape (a new
+        batch geometry changes what the closures dispatch, so the
+        recording must re-warm)."""
+        from .. import engine
+        if not engine.capture_enabled():
+            cap = fs.pop("capture", None)
+            if cap is not None:  # env flipped off mid-run: drain + retire
+                cap.close()
+            return None
+        cap = fs.get("capture")
+        if cap is None:
+            from ..executor import CapturedTrainStep
+            cap = CapturedTrainStep(name="fit_step")
+            fs["capture"] = cap
+        shapes = tuple(tuple(a.shape) for a in
+                       list(data_batch.data) + list(data_batch.label or []))
+        prev = fs.get("capture_shapes")
+        if prev is not None and prev != shapes:
+            cap.invalidate("reshape: %s -> %s" % (prev, shapes))
+            cap.fence()  # old-geometry steps complete before the new load
+        fs["capture_shapes"] = shapes
+        return cap
+
+    def _capture_fence(self):
+        """Happens-before for readers of fused-step results when engine
+        capture pipelines fit_step (no-op otherwise)."""
+        fs = self._fused_fit
+        cap = fs.get("capture") if isinstance(fs, dict) else None
+        if cap is not None:
+            cap.fence()
+
+    def _close_fused_capture(self, reason=None):
+        """Drain + retire the fused path's capture harness (before the
+        fused state is dropped or rebuilt)."""
+        fs = self._fused_fit
+        cap = fs.pop("capture", None) if isinstance(fs, dict) else None
+        if cap is not None:
+            if reason:
+                cap.invalidate(reason)
+            cap.close()
 
     def _fused_fit_state(self):
         """Build (once) or fetch the fused-step state; None if ineligible."""
@@ -568,6 +638,9 @@ class Module(BaseModule):
         fused snapshot (after set_params / a manual update), reusing the
         already-compiled step program. Under ZeRO-1 the refreshed copies go
         straight back to the sharded layout the compiled step expects."""
+        cap = fs.get("capture")
+        if cap is not None:  # in-flight replayed steps finish first
+            cap.fence()
         exec_ = self._exec_group._exec
         fs["params"], fs["states"] = self._fused_snapshot(
             exec_, fs["names"], fs["idx_of"], fs["mesh"], fs["z1"])
@@ -590,6 +663,7 @@ class Module(BaseModule):
     def _sync_fused_to_exec(self):
         """Refresh executor arg buffers + updater state NDArrays from the
         fused step's threaded (donated) values."""
+        self._capture_fence()  # replayed steps land in fs before we read it
         fs = self._fused_fit
         if fs:
             self._materialize_fused_counts(fs)
@@ -611,5 +685,6 @@ class Module(BaseModule):
         assert self.binded
         self._monitor_installed = True
         self._sync_fused_to_exec()
+        self._close_fused_capture("monitor install")
         self._fused_fit = None  # monitor needs per-op taps: unfused path
         self._exec_group.install_monitor(mon)

@@ -87,10 +87,15 @@ class ServingConfig:
     #: min observed requests before the tuner will propose a ladder
     retune_min_samples: int = field(default_factory=lambda: int(
         os.environ.get("MXNET_SERVING_RETUNE_MIN_SAMPLES", "64")))
+    #: engine capture/replay of the steady-state dispatch submission —
+    #: one CapturedSequence per (replica, nominal bucket), invalidated by
+    #: adaptive ladder swaps (engine.CapturedSequence, docs/perf.md)
+    capture: bool = field(default_factory=lambda: engine.capture_enabled())
 
 
 class _Replica:
-    __slots__ = ("index", "cache", "var", "staging", "dispatched")
+    __slots__ = ("index", "cache", "var", "staging", "dispatched",
+                 "captures")
 
     def __init__(self, index: int, cache: BucketCache, var: int,
                  staging: StagingPool):
@@ -99,6 +104,9 @@ class _Replica:
         self.var = var
         self.staging = staging
         self.dispatched = 0
+        # bucket -> CapturedSequence (ServingConfig.capture); written by
+        # the former thread, invalidated+cleared by retune/stop
+        self.captures: Dict[int, "engine.CapturedSequence"] = {}
 
 
 class InferenceServer:
@@ -250,6 +258,9 @@ class InferenceServer:
             engine.untrack_inflight(rep.var)
             engine.delete_variable(rep.var)
             rep.var = None
+            # recorded sequences reference the deleted var; start()
+            # issues a fresh var, so capture re-warms from scratch
+            rep.captures.clear()
         if self._tuner_var is not None:
             engine.wait_for_var(self._tuner_var)
             engine.delete_variable(self._tuner_var)
@@ -334,14 +345,40 @@ class InferenceServer:
             rep = self._pick_replica()
             self._nbatch += 1
             nbatch = self._nbatch
-            engine.push_async(
-                lambda done, batch=batch, rep=rep, nbatch=nbatch:
-                    self._dispatch(batch, rep, nbatch, done),
-                mutable_vars=[rep.var],
-                name="serving_dispatch_r%d" % rep.index)
+            dispatch = (lambda done, batch=batch, rep=rep, nbatch=nbatch:
+                        self._dispatch(batch, rep, nbatch, done))
+            if self.config.capture:
+                self._push_captured(rep, batch, dispatch)
+            else:
+                engine.push_async(
+                    dispatch, mutable_vars=[rep.var],
+                    name="serving_dispatch_r%d" % rep.index)
             if (self._tuner is not None and self.config.retune_interval > 0
                     and nbatch % self.config.retune_interval == 0):
                 self._push_retune()
+
+    def _push_captured(self, rep: _Replica, batch: List[Request],
+                       dispatch: Callable):
+        """Dispatch through the replica's per-bucket CapturedSequence
+        (ServingConfig.capture). The NOMINAL bucket — smallest current
+        ladder rung holding the batch — keys the sequence, so each
+        steady-state shape replays its own recording; ``acquire()`` still
+        chooses the real bucket atomically at run time, so a ladder swap
+        mid-flight never strands a request (its sequence is merely
+        invalidated back to warmup by ``_retune_op``). Only the former
+        thread writes ``rep.captures``."""
+        ladder = self._ladder  # atomic tuple snapshot
+        rows = sum(r.rows for r in batch)
+        bucket = next((b for b in ladder if b >= rows), ladder[-1])
+        cs = rep.captures.get(bucket)
+        if cs is None:
+            cs = engine.CapturedSequence(
+                name="serving_r%d_b%d" % (rep.index, bucket))
+            rep.captures[bucket] = cs
+        cs.begin_step()
+        cs.push_async(dispatch, mutable_vars=(rep.var,),
+                      name="serving_dispatch_r%d" % rep.index)
+        cs.end_step()
 
     def _pick_replica(self) -> _Replica:
         """Routing policy. ``rr``: classic round-robin. ``least_loaded``:
@@ -406,6 +443,14 @@ class InferenceServer:
                     rep.staging.retain(ladder)
                 self._ladder = tuple(ladder)
                 self._ladder_version += 1
+                # captured dispatch sequences recorded against the old
+                # ladder re-warm against the new one; a replay already
+                # submitted keeps running (acquire() is swap-atomic)
+                for rep in self._replicas:
+                    for cs in list(rep.captures.values()):
+                        cs.invalidate("ladder swap v%d"
+                                      % self._ladder_version)
+                    rep.captures.clear()
             telemetry.instant("serving.ladder_swap", domain="serving",
                               version=self._ladder_version,
                               ladder=str(ladder))

@@ -1,0 +1,29 @@
+"""Known-bad fixture: capture-unstable-push.
+
+A push inside a capture region whose var list IS a container mutated in
+the same function — every mutation changes the recorded signature, so
+the sequence silently never stabilizes (or bails on every replay).
+Parsed, never imported.
+"""
+from mxnet_tpu import engine
+
+
+def unstable_capture(batches):
+    seq = engine.CapturedSequence(name="fixture")
+    vars_ = [engine.new_variable()]
+    for _ in batches:
+        vars_.append(engine.new_variable())  # BAD: grows between steps
+        seq.begin_step()
+        seq.push(lambda: None, mutable_vars=vars_, name="op")
+        seq.end_step()
+
+
+def stable_capture(batches):
+    # clean shape: the var list is a frozen snapshot — no finding
+    seq = engine.CapturedSequence(name="fixture_ok")
+    v = engine.new_variable()
+    w = engine.new_variable()
+    for _ in batches:
+        seq.begin_step()
+        seq.push(lambda: None, const_vars=(w,), mutable_vars=(v,), name="op")
+        seq.end_step()

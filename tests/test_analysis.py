@@ -75,6 +75,19 @@ def test_telemetry_in_jit_fixture_flags_trace_time_instrumentation():
     assert all("run" not in f.qualname for f in hits)
 
 
+def test_capture_unstable_fixture_flags_mutated_var_container():
+    fs = analysis.run_analysis(fixture("capture_unstable.py"))
+    hits = [f for f in fs if f.rule == "capture-unstable-push"]
+    # the push whose var list IS the list grown every iteration is
+    # flagged with both the sequence and the container named
+    assert len(hits) == 1
+    assert hits[0].subject == "seq:vars_"
+    assert "unstable_capture" in hits[0].qualname
+    assert "tuple(vars_)" in hits[0].message
+    # the snapshot-tuple shape is clean
+    assert not any(f.qualname.endswith(":stable_capture") for f in fs)
+
+
 def test_clean_fixture_has_no_findings():
     assert analysis.run_analysis(fixture("clean_locks.py")) == []
 
@@ -105,6 +118,8 @@ def test_cli_fail_on_new_gate():
     assert cli_main(["--root", fixture("impure_jit.py"),
                      "--baseline", "none", "--fail-on-new"]) == 1
     assert cli_main(["--root", fixture("telemetry_in_jit.py"),
+                     "--baseline", "none", "--fail-on-new"]) == 1
+    assert cli_main(["--root", fixture("capture_unstable.py"),
                      "--baseline", "none", "--fail-on-new"]) == 1
     # clean fixture: green even with no baseline
     assert cli_main(["--root", fixture("clean_locks.py"),

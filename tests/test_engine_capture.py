@@ -1,0 +1,352 @@
+"""Engine capture/replay: CapturedSequence records a steady-state push
+sequence over warmup iterations, then replays it as ONE engine submission
+with precomputed RAW/WAR/WAW edges (docs/perf.md capture section)."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine
+
+
+def _drive(cs, vars_, out, it):
+    """One 3-op iteration with a RAW chain a->b->c across vars_."""
+    cs.begin_step()
+    cs.push(lambda: out.append(("a", it)), mutable_vars=[vars_[0]], name="a")
+    cs.push(lambda: out.append(("b", it)), const_vars=[vars_[0]],
+            mutable_vars=[vars_[1]], name="b")
+    cs.push_async(lambda done: (out.append(("c", it)), done())[1],
+                  const_vars=[vars_[1]], mutable_vars=[vars_[2]], name="c")
+    cs.end_step()
+
+
+def test_capture_compiles_then_replays_in_dependency_order():
+    out = []
+    vs = [engine.new_variable() for _ in range(3)]
+    cs = engine.CapturedSequence(name="t_order", warmup=2)
+    for it in range(6):
+        _drive(cs, vs, out, it)
+    engine.fence(vs).wait(30)
+    assert cs.state == "ready"
+    assert cs.replays == 4 and cs.bails == 0
+    # dependency semantics hold across eager AND replayed iterations:
+    # within an iteration a_i < b_i < c_i; each op's stream is monotone
+    pos = {e: i for i, e in enumerate(out)}
+    for it in range(6):
+        assert pos[("a", it)] < pos[("b", it)] < pos[("c", it)]
+    for nm in "abc":
+        its = [it for (n, it) in out if n == nm]
+        assert its == sorted(its)
+    # replayed iterations run strictly in recorded order
+    assert out[-12:] == [(n, it) for it in range(2, 6) for n in "abc"]
+
+
+def test_precomputed_edges_are_raw_war_waw():
+    vs = [engine.new_variable() for _ in range(2)]
+    cs = engine.CapturedSequence(name="t_edges", warmup=2)
+    for _ in range(2):
+        cs.begin_step()
+        cs.push(lambda: None, mutable_vars=[vs[0]], name="w0")     # writes 0
+        cs.push(lambda: None, const_vars=[vs[0]],
+                mutable_vars=[vs[1]], name="r0w1")                 # RAW on 0
+        cs.push(lambda: None, mutable_vars=[vs[0]], name="w0b")    # WAW on 0
+        cs.push(lambda: None, const_vars=[vs[1]], name="r1")       # RAW on 1
+        cs.end_step()
+    engine.fence(vs).wait(30)
+    assert cs.state == "ready"
+    deps = [d for _, d in cs._ops]
+    assert deps[0] == ()
+    assert deps[1] == (0,)          # RAW: reads op0's write
+    assert 0 in deps[2]             # WAW on vs[0]
+    assert 1 in deps[2]             # WAR: op1 read vs[0] before this write
+    assert deps[3] == (1,)          # RAW on vs[1]
+    for v in vs:
+        engine.delete_variable(v)
+
+
+def test_warmup_env_default(monkeypatch):
+    monkeypatch.setenv("MXNET_ENGINE_CAPTURE_WARMUP", "4")
+    assert engine.capture_warmup() == 4
+    assert engine.CapturedSequence(name="t").warmup == 4
+    monkeypatch.setenv("MXNET_ENGINE_CAPTURE_WARMUP", "1")
+    assert engine.capture_warmup() == 2  # floor: one observation proves nothing
+    monkeypatch.setenv("MXNET_ENGINE_CAPTURE", "1")
+    assert engine.capture_enabled()
+    monkeypatch.setenv("MXNET_ENGINE_CAPTURE", "0")
+    assert not engine.capture_enabled()
+
+
+def test_unstable_warmup_bails_to_eager_with_logged_reason(caplog):
+    out = []
+    vs = [engine.new_variable() for _ in range(2)]
+    cs = engine.CapturedSequence(name="t_unstable", warmup=2)
+    with caplog.at_level("INFO", logger="mxnet_tpu"):
+        for it in range(4):  # var topology flips every iteration
+            cs.begin_step()
+            cs.push(lambda it=it: out.append(it),
+                    mutable_vars=[vs[it % 2]], name="w")
+            cs.end_step()
+    engine.fence(vs).wait(30)
+    assert cs.state == "eager" and cs.replays == 0
+    assert out == [0, 1, 2, 3]  # every op still ran, eagerly
+    assert any("unstable" in r.message for r in caplog.records)
+    # invalidate() is the one exit from bailed-eager
+    cs.invalidate("topology settled")
+    cs.begin_step()
+    cs.push(lambda: out.append(9), mutable_vars=[vs[0]], name="w")
+    cs.end_step()
+    assert cs.state == "capture"
+    engine.fence(vs).wait(30)
+    for v in vs:
+        engine.delete_variable(v)
+
+
+def test_replay_mismatch_flushes_prefix_in_order_then_recaptures():
+    out = []
+    vs = [engine.new_variable() for _ in range(3)]
+    cs = engine.CapturedSequence(name="t_mismatch", warmup=2)
+    for it in range(4):
+        _drive(cs, vs, out, it)
+    assert cs.state == "ready" and cs.replays == 2
+    # deviate at slot 1: the matched prefix (op a) must flush eagerly
+    # BEFORE the deviating op, preserving program order
+    cs.begin_step()
+    cs.push(lambda: out.append(("a", 99)), mutable_vars=[vs[0]], name="a")
+    cs.push(lambda: out.append(("X", 99)), mutable_vars=[vs[1]], name="X")
+    cs.end_step()
+    engine.fence(vs).wait(30)
+    assert out[-2:] == [("a", 99), ("X", 99)]
+    assert cs.state == "capture" and cs.bails == 1
+    # a short iteration (fewer ops than recorded) also flushes + recaptures
+    for it in range(2):
+        _drive(cs, vs, out, 100 + it)
+    assert cs.state == "ready"
+    cs.begin_step()
+    cs.push(lambda: out.append(("a", 200)), mutable_vars=[vs[0]], name="a")
+    cs.end_step()
+    engine.fence(vs).wait(30)
+    assert out[-1] == ("a", 200)
+    assert cs.state == "capture" and cs.bails == 2
+    for v in vs:
+        engine.delete_variable(v)
+
+
+def test_invalidate_from_another_thread_recaptures():
+    vs = [engine.new_variable()]
+    cs = engine.CapturedSequence(name="t_inval", warmup=2)
+    for _ in range(3):
+        cs.begin_step()
+        cs.push(lambda: None, mutable_vars=vs, name="w")
+        cs.end_step()
+    assert cs.state == "ready"
+    t = threading.Thread(target=cs.invalidate, args=("cross-thread",))
+    t.start()
+    t.join()
+    cs.begin_step()  # consumes the pending invalidation
+    assert cs.state == "capture"
+    cs.push(lambda: None, mutable_vars=vs, name="w")
+    cs.end_step()
+    engine.fence(vs).wait(30)
+    engine.delete_variable(vs[0])
+
+
+def test_replay_composes_with_fence_and_async_on_complete():
+    done_flags = []
+    vs = [engine.new_variable()]
+    gate = threading.Event()
+    cs = engine.CapturedSequence(name="t_fence", warmup=2)
+
+    def op(done):
+        gate.wait(30)
+        done_flags.append(1)
+        done()
+
+    for _ in range(3):
+        cs.begin_step()
+        cs.push_async(op, mutable_vars=vs, name="slow")
+        cs.end_step()
+        gate.set()
+        engine.fence(vs).wait(30)
+        gate.clear()
+    assert cs.replays == 1
+    # fence over the replayed submission's var observed the async child's
+    # on_complete: all three completions landed before the fences returned
+    assert len(done_flags) == 3
+    gate.set()
+    engine.delete_variable(vs[0])
+
+
+def test_inflight_counts_replay_once_two_replicas():
+    """The satellite regression: replica A's sequence replays (3 recorded
+    ops = ONE submission = ONE in-flight count); replica B pushes the same
+    3 ops eagerly (three counts). least_loaded routing reads these."""
+    a, b = engine.new_variable(), engine.new_variable()
+    engine.track_inflight(a)
+    engine.track_inflight(b)
+    try:
+        gate = threading.Event()
+        cs = engine.CapturedSequence(name="t_inflight", warmup=2)
+
+        def seq_ops(push3):
+            push3(lambda: None, "op0")
+            push3(lambda: gate.wait(30), "op1")
+            push3(lambda: None, "op2")
+
+        for _ in range(2):  # warmup (gate open: ops are instant)
+            gate.set()
+            cs.begin_step()
+            seq_ops(lambda fn, nm: cs.push(fn, mutable_vars=[a], name=nm))
+            cs.end_step()
+        engine.fence([a]).wait(30)
+        assert cs.state == "ready"
+        gate.clear()
+        # replica A: one replayed submission of the 3-op sequence
+        cs.begin_step()
+        seq_ops(lambda fn, nm: cs.push(fn, mutable_vars=[a], name=nm))
+        cs.end_step()
+        # replica B: the same 3 ops pushed eagerly
+        for i in range(3):
+            engine.push(lambda: gate.wait(30), mutable_vars=[b],
+                        name="op%d" % i)
+        assert engine.var_inflight(a) == 1  # once per REPLAY, not per op
+        assert engine.var_inflight(b) == 3  # once per eager op
+        gate.set()
+        engine.fence([a, b]).wait(30)
+        assert engine.var_inflight(a) == 0
+        assert engine.var_inflight(b) == 0
+    finally:
+        gate.set()
+        engine.untrack_inflight(a)
+        engine.untrack_inflight(b)
+        engine.delete_variable(a)
+        engine.delete_variable(b)
+
+
+def test_file_var_in_captured_sequence_keeps_write_order(tmp_path):
+    path = str(tmp_path / "ckpt.bin")
+    fv = engine.file_var(path)
+    step_v = engine.new_variable()
+    cs = engine.CapturedSequence(name="t_file", warmup=2)
+    for it in range(5):
+        cs.begin_step()
+        cs.push(lambda it=it: open(path, "w").write(str(it)),
+                mutable_vars=[fv], name="write")
+        cs.push(lambda: None, const_vars=[fv], mutable_vars=[step_v],
+                name="after")
+        cs.end_step()
+    assert cs.replays == 3
+    engine.fence([fv, step_v]).wait(30)
+    assert open(path).read() == "4"  # last write won: order held
+    engine.delete_variable(step_v)
+
+
+def test_fit_step_capture_bitwise_equals_eager(monkeypatch):
+    """End-to-end train-path equivalence + rebind/param-set invalidation."""
+    in_dim, steps = 12, 7
+
+    def build():
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+        sym = mx.sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(sym, data_names=("data",),
+                            label_names=("softmax_label",))
+        mod.bind(data_shapes=[("data", (4, in_dim))],
+                 label_shapes=[("softmax_label", (4,))])
+        r = np.random.RandomState(3)
+        args0 = {n: mx.nd.array(r.uniform(-0.1, 0.1, arr.shape)
+                                .astype(np.float32))
+                 for n, arr in mod._exec_group._exec.arg_dict.items()
+                 if n not in ("data", "softmax_label")}
+        mod.init_params(initializer=None, arg_params=args0)
+        mod.init_optimizer(
+            kvstore=None, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.05), ("momentum", 0.9)))
+        return mod
+
+    def batches():
+        r = np.random.RandomState(4)
+        return [mx.io.DataBatch(
+            data=[mx.nd.array(r.uniform(-1, 1, (4, in_dim))
+                              .astype(np.float32))],
+            label=[mx.nd.array(r.randint(0, 3, (4,)).astype(np.float32))])
+            for _ in range(steps)]
+
+    monkeypatch.delenv("MXNET_ENGINE_CAPTURE", raising=False)
+    mod_e = build()
+    for bt in batches():
+        mod_e.fit_step(bt)
+    w_eager = {n: arr.asnumpy().copy()
+               for n, arr in mod_e.get_params()[0].items()}
+
+    monkeypatch.setenv("MXNET_ENGINE_CAPTURE", "1")
+    mod_c = build()
+    for bt in batches():
+        mod_c.fit_step(bt)
+    cap = mod_c._fused_fit["capture"]
+    assert cap.seq.replays > 0
+    w_cap = {n: arr.asnumpy().copy()
+             for n, arr in mod_c.get_params()[0].items()}
+    for n in w_eager:
+        assert np.array_equal(w_eager[n], w_cap[n]), n
+
+    # param-set invalidates (recording re-warms, training still correct)
+    mod_c.init_params(initializer=None, force_init=True,
+                      arg_params={n: mx.nd.array(v)
+                                  for n, v in w_cap.items()})
+    for bt in batches():
+        mod_c.fit_step(bt)
+    # rebind closes the harness (vars retired, fused state dropped)
+    mod_c.bind(data_shapes=[("data", (4, in_dim))],
+               label_shapes=[("softmax_label", (4,))], force_rebind=True)
+    assert mod_c._fused_fit is None
+    assert cap.data_var is None and cap.step_var is None
+
+
+def test_serving_capture_replays_and_survives_ladder_swap(monkeypatch):
+    """ServingConfig.capture: per-(replica, bucket) sequences replay in
+    steady state; a retune ladder swap invalidates them without failing
+    any in-flight request, and in-flight accounting drains to zero."""
+    from mxnet_tpu import serving
+
+    in_dim = 10
+    data = mx.sym.Variable("data")
+    sym = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    rng = np.random.RandomState(0)
+    shapes, _, _ = sym.infer_shape(data=(1, in_dim))
+    params = {n: rng.uniform(-0.1, 0.1, s).astype(np.float32)
+              for n, s in zip(sym.list_arguments(), shapes) if n != "data"}
+    cfg = serving.ServingConfig(
+        buckets=(1, 4, 8), replicas=2, warm=True, router="least_loaded",
+        adaptive=True, zero_copy=True, max_delay_ms=1.0,
+        retune_min_samples=8, retune_interval=0, capture=True)
+    srv = serving.InferenceServer(sym, params, {"data": (in_dim,)},
+                                  config=cfg)
+    ref = mx.predict.Predictor(sym.tojson(), params, {"data": (1, in_dim)})
+    with srv:
+        # steady 3-row traffic: histogram says the ladder needs a 3 rung
+        outs = [srv.predict(data=np.full((3, in_dim), float(i), np.float32))
+                for i in range(24)]
+        assert sum(cs.replays for rep in srv._replicas
+                   for cs in rep.captures.values()) > 0
+        v0 = srv.ladder_version
+        srv.retune_now(wait=True)
+        assert srv.ladder_version > v0, "tuner never swapped the ladder"
+        ladder = srv.current_ladder()
+        # swap invalidated/cleared the recordings; traffic continues and
+        # re-warms against the new ladder without a single failed request
+        outs2 = [srv.predict(data=np.full((3, in_dim), float(i), np.float32))
+                 for i in range(24)]
+        for rep in srv._replicas:
+            assert set(rep.captures) <= set(ladder)
+    for i, o in enumerate(list(outs) + list(outs2)):
+        want = np.concatenate(
+            [ref.forward(data=np.full((1, in_dim), float(i % 24),
+                                      np.float32))[0].asnumpy()] * 3)
+        np.testing.assert_allclose(o[0], want, rtol=1e-5, atol=1e-6)
+    nv = dict(zip(*srv.get_metrics()))
+    assert nv["completed"] == 48
+    assert nv.get("router_inflight_replica0", 0) == 0
+    assert nv.get("router_inflight_replica1", 0) == 0

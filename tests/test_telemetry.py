@@ -295,6 +295,23 @@ def test_trace_dump_roundtrip_covers_engine_serving_kvstore(tmp_path):
             t.join()
     assert len(results) == 12
 
+    # captured-sequence replay (ISSUE 6): each replayed iteration is ONE
+    # "engine.replay" span; the ops inside keep their original names as
+    # child events tagged args.replay so a trace reads the same pre/post
+    # capture
+    vs = [engine.new_variable(), engine.new_variable()]
+    cs = engine.CapturedSequence(name="rt", warmup=2)
+    for _ in range(3):
+        cs.begin_step()
+        cs.push(lambda: None, mutable_vars=[vs[0]], name="rt_load")
+        cs.push_async(lambda done: done(), const_vars=[vs[0]],
+                      mutable_vars=[vs[1]], name="rt_step")
+        cs.end_step()
+    engine.fence(vs).wait(30)
+    assert cs.replays == 1
+    for v in vs:
+        engine.delete_variable(v)
+
     out_file = tmp_path / "roundtrip.json"
     profiler.profiler_set_config(filename=str(out_file))
     path = profiler.dump_profile()
@@ -313,6 +330,17 @@ def test_trace_dump_roundtrip_covers_engine_serving_kvstore(tmp_path):
     disp = [e for e in evs if e["name"] == "serving.dispatch"]
     assert {e["args"]["replica"] for e in disp} == {0, 1}
     assert all("bucket" in e["args"] for e in disp)
+
+    # exactly one replay span for the one replayed iteration, carrying
+    # the sequence identity; both ops appear under their original names
+    # as replay-tagged children
+    reps = [e for e in evs if e["name"] == "engine.replay"]
+    assert len(reps) == 1
+    assert reps[0]["args"] == {"ops": 2, "sequence": "rt"}
+    for opname in ("rt_load", "rt_step"):
+        kids = [e for e in evs if e["name"] == opname
+                and e.get("args", {}).get("replay")]
+        assert len(kids) == 1, opname
 
     # well-formed: pid/tid ints, ts µs floats, X events carry dur >= 0,
     # and timestamps are monotonic per tid
