@@ -73,4 +73,13 @@ done
 JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis \
     --root tests/fixtures/analysis/clean_locks.py --baseline none --fail-on-new
 
+echo "== stage 8: fault-injection dry-run (kill-a-rank recovery, CPU) =="
+# Elastic-training gate: under a deterministic MXNET_FAULT_PLAN a
+# supervised run loses rank 1 mid-training, restores the last committed
+# async sharded checkpoint and replays to BIT-IDENTICAL weights; the
+# dp=4 -> 2 -> 4 resharding round-trip is checked bitwise in the same
+# entry point (docs/fault_tolerance.md).
+JAX_PLATFORMS=cpu MXNET_FAULT_PLAN="kill_rank rank=1 step=5" \
+    python -c "import __graft_entry__ as g; g.dryrun_fault_tolerance()"
+
 echo "ALL CI STAGES PASSED"

@@ -12,7 +12,7 @@ makes XLA's multiply-by-zero the skip. Linearly-decayed survival
 probabilities per depth, train-time sampling vs test-time expectation,
 accuracy asserted on held-out data.
 
-    python examples/stochastic-depth/sd_mnist.py --steps 60
+    python examples/stochastic-depth/sd_mnist.py --steps 120
 """
 import argparse
 import os
@@ -59,7 +59,7 @@ def survival_probs():
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--steps", type=int, default=120)
     p.add_argument("--batch-size", type=int, default=32)
     args = p.parse_args()
 

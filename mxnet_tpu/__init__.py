@@ -38,6 +38,7 @@ _TRAINING_SURFACE = frozenset((
     "kvstore_server", "operator", "models", "recordio", "rtc", "engine",
     "rnn", "profiler", "image", "registry", "log", "libinfo", "contrib",
     "notebook", "plugins", "misc", "torch", "th", "filesystem",
+    "resilience",
 ))
 
 if not _PREDICT_ONLY:
@@ -103,5 +104,13 @@ def __getattr__(name):
 
         m = importlib.import_module(".analysis", __name__)
         globals()["analysis"] = m
+        return m
+    # mx.resilience (sharded checkpoints, fault injection, supervisor):
+    # training-surface depth, lazy so plain imports never pay for it.
+    if name == "resilience":
+        import importlib
+
+        m = importlib.import_module(".resilience", __name__)
+        globals()["resilience"] = m
         return m
     raise AttributeError("module %r has no attribute %r" % (__name__, name))

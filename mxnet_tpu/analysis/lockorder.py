@@ -78,6 +78,9 @@ LOCK_HIERARCHY: Dict[str, int] = {
     "kvstore_server.PSClient._locks[*]": 60,
     "kvstore_server.PSClient._ctrl_lock": 60,
     "kvstore.PSKVStore._errs_lock": 100,
+    # fault-injection plan table: leaf — match/fire bookkeeping only; the
+    # telemetry counter inc happens after release (docs/fault_tolerance.md).
+    "resilience.faults._lock": 100,
     "torch._TH_LOCK": 90,
     "io.DevicePrefetchIter._lock": 100,
     "random._lock": 100,

@@ -40,6 +40,35 @@ from .base import MXNetError
 _OPR_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p)
 _DEL_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
 
+# --- op-error observation -----------------------------------------------------
+# The engine NEVER lets an op exception escape the worker (it would cross the
+# C boundary / kill the worker loop); by default a failed op prints its
+# traceback and the run continues. A process-wide handler lets supervision
+# layers (mxnet_tpu.resilience) OBSERVE those swallowed failures — e.g. to
+# count injected faults or trigger a restore — without changing engine
+# semantics. Plain module global, set once at startup: no lock needed.
+_op_error_handler: Optional[Callable[[str, BaseException], None]] = None
+
+
+def set_error_handler(fn: Optional[Callable[[str, BaseException], None]]):
+    """Install ``fn(op_name, exc)`` to observe engine-op exceptions (which
+    are otherwise only printed). Pass ``None`` to reset. Returns the
+    previously installed handler. The handler runs ON the engine worker —
+    it must be fast and must not raise (a raising handler is swallowed)."""
+    global _op_error_handler
+    prev = _op_error_handler
+    _op_error_handler = fn
+    return prev
+
+
+def _notify_op_error(name: str, exc: BaseException):
+    h = _op_error_handler
+    if h is not None:
+        try:
+            h(name, exc)
+        except Exception:  # an observing hook must never break dispatch
+            traceback.print_exc()
+
 
 def _load_native() -> Optional[ctypes.CDLL]:
     from . import native as _native
@@ -131,8 +160,9 @@ class NativeEngine:
                         fn()
                 else:
                     fn()
-        except Exception:  # never let an exception cross the C boundary
+        except Exception as e:  # never let an exception cross the C boundary
             traceback.print_exc()
+            _notify_op_error(name, e)
             if is_async:
                 _telemetry.end(tok, error=True)
                 self._lib.mxe_opr_complete(self._h, ctypes.c_void_p(on_complete))
@@ -223,11 +253,12 @@ class PythonEngine:
 
     def _worker(self):
         while True:
-            fn = self._queue.get()
+            fn, name = self._queue.get()
             try:
                 fn()
-            except Exception:  # never kill the worker loop
+            except Exception as e:  # never kill the worker loop
                 traceback.print_exc()
+                _notify_op_error(name, e)
             finally:
                 self._queue.task_done()
 
@@ -257,7 +288,7 @@ class PythonEngine:
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0, name="op"):
         t_q = _telemetry.clock_ns() if _telemetry.enabled("engine") else 0
         if self._queue is not None:
-            self._queue.put(lambda: self._run_profiled(fn, name, t_q))
+            self._queue.put((lambda: self._run_profiled(fn, name, t_q), name))
         else:
             self._run_profiled(fn, name, t_q)
 
@@ -271,7 +302,7 @@ class PythonEngine:
             done.wait()  # hold the FIFO slot until on_complete fires
 
         if self._queue is not None:
-            self._queue.put(lambda: self._run_profiled(run, name, t_q))
+            self._queue.put((lambda: self._run_profiled(run, name, t_q), name))
         else:
             self._run_profiled(run, name, t_q)
 
@@ -722,8 +753,9 @@ class CapturedSequence:
                                 fn()
                         else:
                             fn()
-                except Exception:  # mirror _dispatch: never escape the op
+                except Exception as e:  # mirror _dispatch: never escape the op
                     traceback.print_exc()
+                    _notify_op_error(opname, e)
                     if events[i] is not None:
                         events[i].set()
             # the submission completes only when every child has: that is
@@ -845,7 +877,8 @@ def file_var(path: str) -> int:
 
 
 def push_file_write(path: str, fn: Callable[[], None], wait: bool = True,
-                    name: Optional[str] = None):
+                    name: Optional[str] = None,
+                    after_paths: Sequence[str] = ()):
     """Run ``fn`` (which writes ``path``) as an engine op holding the
     path's write-var. ``wait=False`` returns immediately — the write
     overlaps whatever the caller does next. A failed async write
@@ -853,10 +886,18 @@ def push_file_write(path: str, fn: Callable[[], None], wait: bool = True,
     ``push_file_write``/``wait_for_all`` on ANY path (per-epoch
     checkpoints use distinct filenames, so surfacing must not be
     per-path-only — a full disk would otherwise lose every later
-    checkpoint silently)."""
+    checkpoint silently).
+
+    ``after_paths`` orders this write AFTER every previously enqueued
+    write on those paths (their file-vars become const deps): the
+    commit-manifest-after-all-shards edge sharded checkpoints need —
+    the manifest op cannot run until every shard op finished, so a
+    crash at any point leaves either no manifest or a manifest whose
+    shards are all fully on disk."""
     apath = os.path.abspath(path)
     _raise_pending_file_error()
     eng = get()
+    deps = []
     with _file_lock:
         var = _file_vars.get(apath)
         if var is None:
@@ -865,6 +906,19 @@ def push_file_write(path: str, fn: Callable[[], None], wait: bool = True,
         # counted under the SAME lock acquisition that resolved the var,
         # so wait_for_file can never retire a var with a write en route
         _file_pending[apath] = _file_pending.get(apath, 0) + 1
+        dep_paths = []
+        for p in after_paths:
+            ap = os.path.abspath(p)
+            if ap == apath:
+                continue
+            dv = _file_vars.get(ap)
+            if dv is None:
+                continue  # nothing ever written there: no edge needed
+            deps.append(dv)
+            dep_paths.append(ap)
+            # pin the dep vars against retirement until this op completes
+            # (a const reader is invisible to _file_pending otherwise)
+            _file_pending[ap] = _file_pending.get(ap, 0) + 1
 
     def run():
         try:
@@ -875,8 +929,10 @@ def push_file_write(path: str, fn: Callable[[], None], wait: bool = True,
         finally:
             with _file_lock:
                 _file_pending[apath] -= 1
+                for ap in dep_paths:
+                    _file_pending[ap] -= 1
 
-    eng.push(run, mutable_vars=[var],
+    eng.push(run, const_vars=deps, mutable_vars=[var],
              name=name or ("file_write:%s" % os.path.basename(apath)))
     if wait:
         wait_for_file(apath)

@@ -188,6 +188,10 @@ class KVStoreServer:
 
     def _handle(self, conn, req):
         op = req[0]
+        if op in ("push", "pull"):  # MXNET_FAULT_PLAN: delayed replies
+            from .resilience import faults
+
+            faults.maybe_delay("ps_server_%s" % op)
         if op == "init":
             key, val = req[1], req[2]
             if key not in self.store:  # first init wins (rank-0 semantics)
@@ -421,21 +425,39 @@ class PSClient:
     def _ensure_conn(self, sid):
         """Connect (caller holds self._locks[sid]); retry until the server
         binds — launchers start workers and servers concurrently, and
-        ps-lite likewise reconnects."""
+        ps-lite likewise reconnects. Backoff/deadline come from the one
+        RetryPolicy (MXNET_TPU_PS_CONNECT_TIMEOUT + MXNET_TPU_PS_RETRY_*)."""
         conn = self._conns[sid]
         if conn is None:
-            deadline = time.time() + float(os.environ.get(
-                "MXNET_TPU_PS_CONNECT_TIMEOUT", "60"))
-            while True:
-                try:
-                    conn = Client(self.addresses[sid], authkey=_AUTH)
-                    break
-                except (ConnectionRefusedError, FileNotFoundError, OSError):
-                    if time.time() > deadline:
-                        raise
-                    time.sleep(0.2)
+            from .resilience.retry import RetryPolicy
+
+            conn = RetryPolicy.for_connect().call(
+                lambda: Client(self.addresses[sid], authkey=_AUTH),
+                retry_on=(ConnectionRefusedError, FileNotFoundError,
+                          OSError),
+                what="connect to ps server %s" % (self.addresses[sid],))
             self._conns[sid] = conn
         return conn
+
+    def _inject(self, op, sid=None):
+        """MXNET_FAULT_PLAN hooks for the PS data path: an armed
+        ``conn_drop`` severs the (data or control) connection exactly as
+        a dying server would — the raised OSError travels the real
+        failure path; ``delay`` simulates a slow reply."""
+        from .resilience import faults
+
+        faults.maybe_delay(op)
+        if faults.maybe_drop(op):
+            if sid is None:
+                conn, self._ctrl = self._ctrl, None
+            else:
+                conn, self._conns[sid] = self._conns[sid], None
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            raise OSError("injected conn_drop at %s" % op)
 
     @staticmethod
     def _check(resp):
@@ -445,6 +467,7 @@ class PSClient:
 
     def _rpc(self, sid, *req):
         with self._locks[sid]:
+            self._inject("ps_%s" % req[0], sid)
             conn = self._ensure_conn(sid)
             send_msg(conn, *req)
             resp = recv_msg(conn)
@@ -526,18 +549,16 @@ class PSClient:
 
     def _ctrl_rpc(self, *req):
         with self._ctrl_lock:
+            self._inject("ps_ctrl_%s" % req[0])
             if self._ctrl is None:
-                deadline = time.time() + float(os.environ.get(
-                    "MXNET_TPU_PS_CONNECT_TIMEOUT", "60"))
-                while True:
-                    try:
-                        self._ctrl = Client(self.addresses[0], authkey=_AUTH)
-                        break
-                    except (ConnectionRefusedError, FileNotFoundError,
-                            OSError):
-                        if time.time() > deadline:
-                            raise
-                        time.sleep(0.2)
+                from .resilience.retry import RetryPolicy
+
+                self._ctrl = RetryPolicy.for_connect().call(
+                    lambda: Client(self.addresses[0], authkey=_AUTH),
+                    retry_on=(ConnectionRefusedError, FileNotFoundError,
+                              OSError),
+                    what="connect ps control channel %s"
+                         % (self.addresses[0],))
             send_msg(self._ctrl, *req)
             resp = recv_msg(self._ctrl)
         return self._check(resp)

@@ -116,8 +116,23 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
         save_dict.update({("aux:%s" % k): nd.NDArray(v._data)
                           for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    engine.push_file_write(param_name,
-                           lambda: nd.save(param_name, save_dict),
+
+    def _write():
+        # atomic commit: serialize into *.params.tmp, then os.replace —
+        # a crash at ANY point (including mid-serialization) leaves the
+        # previously committed file intact and loadable. The fault hook
+        # sits between write and rename: the worst crash point.
+        import os as _os
+
+        from .resilience import faults
+
+        tmp = param_name + ".tmp"
+        nd.save(tmp, save_dict)
+        faults.maybe_raise("checkpoint_write:%s"
+                           % _os.path.basename(param_name))
+        _os.replace(tmp, param_name)
+
+    engine.push_file_write(param_name, _write,
                            wait=not async_write, name="checkpoint_write")
     logging.info("Saved checkpoint to \"%s\"%s", param_name,
                  " (async)" if async_write else "")

@@ -264,7 +264,15 @@ class BaseModule:
                      for k, v in arg_params.items()}
         save_dict.update({("aux:%s" % k): nd.NDArray(v._data)
                           for k, v in aux_params.items()})
-        engine.push_file_write(fname, lambda: nd.save(fname, save_dict),
+        def _write():
+            # tmp + os.replace: a crash mid-write never clobbers the
+            # previously committed params file
+            import os as _os
+
+            nd.save(fname + ".tmp", save_dict)
+            _os.replace(fname + ".tmp", fname)
+
+        engine.push_file_write(fname, _write,
                                wait=not async_write, name="save_params")
 
     def load_params(self, fname):

@@ -398,8 +398,12 @@ class Module(BaseModule):
             blob = self._updater.get_states()  # snapshot at call time
 
             def write():
-                with open(fname, "wb") as fout:
+                # atomic: tmp + os.replace (crash-safe like save_params)
+                import os as _os
+
+                with open(fname + ".tmp", "wb") as fout:
                     fout.write(blob)
+                _os.replace(fname + ".tmp", fname)
 
             engine.push_file_write(fname, write, wait=not async_write,
                                    name="save_optimizer_states")
@@ -417,6 +421,97 @@ class Module(BaseModule):
             engine.wait_for_file(fname)
             with open(fname, "rb") as f:
                 self._updater.set_states(f.read())
+
+    # --- resumable training state (mxnet_tpu.resilience) ------------------
+    def get_checkpoint_state(self):
+        """Everything a resumed job needs, as host arrays: f32 master
+        params (``param:<name>``), aux states (``aux:<name>``), optimizer
+        state leaves (``opt:<name>:<leaf>``), plus an ``opt_meta`` dict
+        with the update counts. The flat dict feeds
+        ``resilience.checkpoint.save_sharded`` directly; the snapshot is
+        consistent (fused/donated buffers are synced out first)."""
+        assert self.binded and self.params_initialized
+        arg_params, aux_params = self.get_params()  # syncs fused → exec
+        arrays = {}
+        for n, a in arg_params.items():
+            arrays["param:%s" % n] = a.asnumpy()
+        for n, a in aux_params.items():
+            arrays["aux:%s" % n] = a.asnumpy()
+        opt_meta = {}
+        if self.optimizer_initialized and self._updater is not None:
+            nd_dev = len(self._context)
+            for pos, n in enumerate(self._exec_group.param_names):
+                leaves = state_leaves(
+                    self._updater.states.get(pos * nd_dev))
+                if leaves is None:
+                    continue
+                if not isinstance(leaves, tuple):
+                    leaves = (leaves,)
+                for li, leaf in enumerate(leaves):
+                    if leaf is not None:
+                        arrays["opt:%s:%d" % (n, li)] = np.asarray(leaf)
+            opt_ = self._optimizer
+            opt_meta = {
+                "num_update": int(opt_.num_update),
+                "index_update_count": {
+                    str(k): int(v)
+                    for k, v in opt_._index_update_count.items()},
+            }
+        return arrays, opt_meta
+
+    def restore_checkpoint_state(self, arrays, opt_meta=None):
+        """Inverse of :meth:`get_checkpoint_state`: install params, aux,
+        optimizer-state leaves and update counts from a (possibly
+        resharded) ``resilience.checkpoint`` restore. The fused step
+        state is retired so the next ``fit_step`` re-snapshots from the
+        restored buffers."""
+        assert self.binded and self.params_initialized
+        arg_params, aux_params, opt_leaves = {}, {}, {}
+        for key, a in arrays.items():
+            kind, _, rest = key.partition(":")
+            if kind == "param":
+                arg_params[rest] = nd.array(a)
+            elif kind == "aux":
+                aux_params[rest] = nd.array(a)
+            elif kind == "opt":
+                name, _, li = rest.rpartition(":")
+                opt_leaves.setdefault(name, {})[int(li)] = a
+            else:
+                raise MXNetError("unknown checkpoint key %r" % key)
+        self.set_params(arg_params, aux_params,
+                        allow_missing=not arg_params)
+        if not (self.optimizer_initialized and self._updater is not None):
+            return
+        self._sync_fused_to_exec()
+        self._close_fused_capture("checkpoint restore")
+        self._fused_fit = None  # re-snapshot from the restored buffers
+        nd_dev = len(self._context)
+        exec_ = self._exec_group._exec
+        hyper_key = self._optimizer._hyperparam_key()
+        for pos, n in enumerate(self._exec_group.param_names):
+            entry = opt_leaves.get(n)
+            if not entry:
+                continue
+            st = self._updater.ensure_state(pos * nd_dev,
+                                            exec_.arg_dict[n],
+                                            key=hyper_key)
+            cur = state_leaves(st)
+            if isinstance(cur, tuple):
+                vals = tuple(
+                    None if c is None else jnp.asarray(
+                        entry[i]).astype(c.dtype)
+                    for i, c in enumerate(cur))
+            else:
+                vals = jnp.asarray(entry[0]).astype(cur.dtype)
+            write_state_leaves(st, vals)
+        if opt_meta:
+            opt_ = self._optimizer
+            opt_.num_update = int(opt_meta.get("num_update",
+                                               opt_.num_update))
+            opt_._index_update_count = {
+                int(k): int(v)
+                for k, v in opt_meta.get("index_update_count",
+                                         {}).items()}
 
     # --- fused fit step ---------------------------------------------------
     def fit_step(self, data_batch):

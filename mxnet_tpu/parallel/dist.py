@@ -127,21 +127,37 @@ def barrier(name: str = "barrier"):
         multihost_utils.sync_global_devices(name)
 
 
+def dead_nodes(step: Optional[int] = None) -> set:
+    """Ranks currently considered dead — the poll surface the
+    resilience.TrainingSupervisor consults between steps.
+
+    Under jax.distributed a really-failed host aborts the job rather
+    than running degraded, so live detection comes from the PS kvstore
+    (``kv.num_dead_node`` / ``PSClient.dead_nodes``); what THIS function
+    contributes is the simulated layer: ``kill_rank`` entries of the
+    active ``MXNET_FAULT_PLAN`` (mxnet_tpu.resilience.faults) read as
+    dead from their planned step on, through the same surface real
+    deaths would use."""
+    from ..resilience import faults  # lazy: resilience is optional depth
+
+    return set(faults.killed_ranks(step))
+
+
 def num_dead_nodes(timeout_s: float = 0.0) -> int:
     """Dead-node surface (reference MXKVStoreGetNumDeadNode,
     kvstore_dist.h:159-168). Under jax.distributed a failed host aborts
-    the job rather than running degraded, so a live call always sees 0;
-    the API exists so reference callers port cleanly, and the timeout is
-    honored as a liveness probe window."""
-    if timeout_s > 0:
+    the job rather than running degraded, so a live call sees only
+    simulated deaths (:func:`dead_nodes`); the timeout is honored as a
+    liveness probe window."""
+    if timeout_s > 0 and not dead_nodes():
         deadline = time.time() + timeout_s
-        while time.time() < deadline:
+        while time.time() < deadline and not dead_nodes():
             time.sleep(min(0.1, deadline - time.time()))
-    return 0
+    return len(dead_nodes())
 
 
 def is_recovery() -> bool:
     """Recovery flag (reference ps::Postoffice::is_recovery). Restarted
-    jobs resume from checkpoints (orbax/save_checkpoint) instead of
-    rejoining live — always False."""
+    jobs resume from checkpoints (resilience.load_sharded /
+    save_checkpoint) instead of rejoining live — always False."""
     return False

@@ -1,0 +1,31 @@
+# coding: utf-8
+"""mxnet_tpu.resilience — elastic fault-tolerant training.
+
+Four pieces (docs/fault_tolerance.md):
+
+- :mod:`~mxnet_tpu.resilience.checkpoint` — async sharded checkpoints
+  with a crash-safe manifest commit and restore-with-resharding
+  (``save_sharded`` / ``load_sharded`` / ``reshard`` / ``latest_step``);
+- :mod:`~mxnet_tpu.resilience.supervisor` — ``TrainingSupervisor``,
+  the poll/restore/resume train loop;
+- :mod:`~mxnet_tpu.resilience.retry` — ``RetryPolicy``, the one
+  jittered-backoff-under-deadline implementation (PS connects use it);
+- :mod:`~mxnet_tpu.resilience.faults` — the deterministic
+  ``MXNET_FAULT_PLAN`` fault-injection harness that makes failure a
+  replayable test input.
+"""
+from . import checkpoint, faults, retry, supervisor
+from .checkpoint import (CheckpointHandle, RestoredCheckpoint,
+                         fingerprint_arrays, latest_step, list_steps,
+                         load_sharded, reshard, save_sharded)
+from .faults import InjectedFault
+from .retry import RetryError, RetryPolicy
+from .supervisor import RecoveryError, TrainingSupervisor
+
+__all__ = [
+    "checkpoint", "faults", "retry", "supervisor",
+    "CheckpointHandle", "RestoredCheckpoint", "fingerprint_arrays",
+    "latest_step", "list_steps", "load_sharded", "reshard",
+    "save_sharded", "InjectedFault", "RetryError", "RetryPolicy",
+    "RecoveryError", "TrainingSupervisor",
+]

@@ -1,0 +1,411 @@
+# coding: utf-8
+"""Async sharded checkpoints with crash-safe commit and resharding.
+
+Layout on disk for ``save_sharded(prefix, step, ..., num_shards=N)``::
+
+    {prefix}-step0000042.shard-000-of-004.ckpt      one per rank
+    ...
+    {prefix}-step0000042.manifest.json              the COMMIT record
+
+Every tensor is flattened to 1-D and split into N near-equal CONTIGUOUS
+ranges (``per, rem = divmod(size, N)``; rank r takes ``per + (r < rem)``
+elements — the same plan ``PSClient`` uses for big-array striping). That
+makes resharding pure concatenation/re-slicing: a checkpoint written at
+dp=N restores BITWISE at any dp=M, params and optimizer state alike
+(the ZeRO-1 story from Xu et al.: each replica owns — and therefore
+checkpoints — 1/N of the f32 masters + optimizer state).
+
+Shard files are a deterministic binary format (NOT ``np.savez``, whose
+zip container embeds timestamps — byte-identical round-trips are part
+of the contract here)::
+
+    magic  b"MXTPUCKPT\\x01"
+    u64le  header length
+    json   {"entries": [[name, dtype, count], ...]}   (sorted by name)
+    raw    concatenated little-endian buffers, entry order
+
+Commit protocol (the crash-safety argument):
+
+1. every shard serializes, writes ``*.tmp``, then ``os.replace``s into
+   place — a torn write can never be mistaken for a shard;
+2. the manifest write is an engine op ordered AFTER all N shard ops
+   (``engine.push_file_write(after_paths=shard_paths)``) and itself goes
+   tmp → ``os.replace``;
+3. therefore at any crash point the newest *manifest* on disk describes
+   only fully-written shards, and :func:`latest_step` (which requires a
+   parseable manifest + all shards present with the recorded sizes)
+   never selects a torn checkpoint. CRCs are verified at load.
+
+All writes ride the engine's file-write vars (one per path), so
+``async_write=True`` never blocks the train loop; the returned
+:class:`CheckpointHandle` exposes ``done()``/``wait()`` and surfaces
+write errors exactly like other async file ops.
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import time
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import engine
+from .. import telemetry as _telemetry
+from ..base import MXNetError
+
+__all__ = ["CheckpointHandle", "RestoredCheckpoint", "save_sharded",
+           "load_sharded", "reshard", "latest_step", "list_steps",
+           "fingerprint_arrays", "MANIFEST_VERSION"]
+
+MANIFEST_VERSION = 1
+_MAGIC = b"MXTPUCKPT\x01"
+
+_ckpt_total = _telemetry.registry.counter(
+    "resilience_checkpoints_total", help="Sharded checkpoints committed")
+_ckpt_bytes = _telemetry.registry.counter(
+    "resilience_checkpoint_bytes_total",
+    help="Bytes written by sharded checkpoints (shards + manifests)")
+_ckpt_last_ms = _telemetry.registry.gauge(
+    "resilience_checkpoint_last_ms",
+    help="Wall ms of the last checkpoint commit (snapshot to manifest)")
+_restore_total = _telemetry.registry.counter(
+    "resilience_restores_total", help="Sharded checkpoints restored")
+
+
+def _shard_path(prefix: str, step: int, rank: int, num: int) -> str:
+    return "%s-step%07d.shard-%03d-of-%03d.ckpt" % (prefix, step, rank, num)
+
+
+def _manifest_path(prefix: str, step: int) -> str:
+    return "%s-step%07d.manifest.json" % (prefix, step)
+
+
+def _shard_range(size: int, rank: int, num: int) -> Tuple[int, int]:
+    """Contiguous [lo, hi) of a flattened size-``size`` tensor owned by
+    ``rank`` of ``num`` (PSClient._plan split: remainder to low ranks)."""
+    per, rem = divmod(size, num)
+    lo = rank * per + min(rank, rem)
+    return lo, lo + per + (1 if rank < rem else 0)
+
+
+def fingerprint_arrays(arrays: Dict[str, np.ndarray]) -> str:
+    """Model fingerprint: sha1 over the sorted (name, shape, dtype)
+    catalog. Restoring into a module with a different catalog is a bug
+    the manifest check turns into a clear error."""
+    h = hashlib.sha1()
+    for name in sorted(arrays):
+        a = arrays[name]
+        h.update(("%s|%s|%s;" % (name, tuple(a.shape),
+                                 np.dtype(a.dtype).str)).encode())
+    return h.hexdigest()
+
+
+def _serialize_shard(entries: List[Tuple[str, np.ndarray]]) -> bytes:
+    """Deterministic shard bytes for [(name, 1-D slice), ...]."""
+    header = json.dumps(
+        {"entries": [[n, np.dtype(a.dtype).str, int(a.size)]
+                     for n, a in entries]},
+        sort_keys=True, separators=(",", ":")).encode()
+    buf = io.BytesIO()
+    buf.write(_MAGIC)
+    buf.write(len(header).to_bytes(8, "little"))
+    buf.write(header)
+    for _, a in entries:
+        buf.write(np.ascontiguousarray(a).tobytes())
+    return buf.getvalue()
+
+
+def _parse_shard(data: bytes, path: str) -> Dict[str, np.ndarray]:
+    if data[:len(_MAGIC)] != _MAGIC:
+        raise MXNetError("bad shard magic in %s" % path)
+    off = len(_MAGIC)
+    hlen = int.from_bytes(data[off:off + 8], "little")
+    off += 8
+    header = json.loads(data[off:off + hlen])
+    off += hlen
+    out: Dict[str, np.ndarray] = {}
+    for name, dtype, count in header["entries"]:
+        dt = np.dtype(dtype)
+        nbytes = count * dt.itemsize
+        out[name] = np.frombuffer(
+            data[off:off + nbytes], dtype=dt).copy()
+        off += nbytes
+    if off != len(data):
+        raise MXNetError("trailing bytes in shard %s" % path)
+    return out
+
+
+def _atomic_write(path: str, data: bytes):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class CheckpointHandle:
+    """Async-commit handle: ``done()`` probes, ``wait()`` blocks and
+    surfaces any write failure (fault-injected or real)."""
+
+    def __init__(self, prefix: str, step: int, paths: List[str]):
+        self.prefix = prefix
+        self.step = step
+        self.paths = list(paths)
+        self._fence = engine.fence(
+            [engine.file_var(p) for p in self.paths], name="ckpt_fence")
+
+    def done(self) -> bool:
+        return self._fence.done()
+
+    def wait(self, timeout: Optional[float] = None) -> "CheckpointHandle":
+        """Block until every shard + the manifest op completed; re-raise
+        the first recorded write error (the checkpoint is then NOT
+        committed — the previous manifest stays authoritative)."""
+        self._fence.wait(timeout)
+        first = None
+        for p in self.paths:
+            try:
+                engine.wait_for_file(p)
+            except BaseException as e:
+                if first is None:
+                    first = e
+        if first is not None:
+            raise first
+        return self
+
+
+def save_sharded(prefix: str, step: int, arrays: Dict[str, np.ndarray],
+                 num_shards: int, *, opt_meta: Optional[dict] = None,
+                 fingerprint: Optional[str] = None,
+                 async_write: bool = True) -> CheckpointHandle:
+    """Write ``arrays`` as ``num_shards`` shard files + a manifest.
+
+    ``arrays`` maps flat names (the module layer uses ``param:<name>``,
+    ``aux:<name>``, ``opt:<name>:<leaf>``) to host ndarrays. The arrays
+    themselves ARE the snapshot — ``module.get_checkpoint_state()``
+    returns fresh host copies, so the device->host copy the caller
+    already paid is the only synchronous cost; slicing, serialization,
+    CRC, and disk I/O all run inside the background engine ops. The
+    contract: callers must not mutate ``arrays`` until the handle
+    commits (the train loop updating *device* weights is fine). Each
+    shard is its own engine op (one per replica in a real dp run), the
+    manifest ordered after all of them. ``opt_meta`` carries scalar
+    optimizer bookkeeping (update counts) that belongs to no shard."""
+    if num_shards < 1:
+        raise ValueError("num_shards must be >= 1 (got %d)" % num_shards)
+    t0 = time.monotonic()
+    names = sorted(arrays)
+    catalog = {n: {"shape": list(arrays[n].shape),
+                   "dtype": np.dtype(arrays[n].dtype).str} for n in names}
+    fp = fingerprint or fingerprint_arrays(arrays)
+    shard_paths = [_shard_path(prefix, step, r, num_shards)
+                   for r in range(num_shards)]
+    # shard idx -> (crc32, nbytes), written by the shard ops; the
+    # manifest op is ordered strictly after every shard op (the
+    # after_paths commit edge), so reading it there is race-free
+    results: Dict[int, tuple] = {}
+
+    with _telemetry.span("resilience.checkpoint", domain="resilience",
+                         step=step, num_shards=num_shards):
+        # faults/maybe_raise stays inside the pushed op so an injected
+        # failure exercises the real async-error path.
+        def _shard_writer(r, path):
+            def run():
+                from . import faults
+                faults.maybe_raise("ckpt_shard:%s" % os.path.basename(path))
+                entries = []
+                for n in names:
+                    flat = np.ascontiguousarray(arrays[n]).reshape(-1)
+                    lo, hi = _shard_range(flat.size, r, num_shards)
+                    entries.append((n, flat[lo:hi]))
+                blob = _serialize_shard(entries)
+                results[r] = (zlib.crc32(blob) & 0xFFFFFFFF, len(blob))
+                _atomic_write(path, blob)
+                _ckpt_bytes.inc(len(blob))
+            return run
+
+        for r, path in enumerate(shard_paths):
+            engine.push_file_write(path, _shard_writer(r, path),
+                                   wait=False, name="ckpt_shard")
+
+        mpath = _manifest_path(prefix, step)
+
+        def _manifest_writer():
+            from . import faults
+            faults.maybe_raise("ckpt_manifest")
+            if len(results) != num_shards:
+                raise MXNetError(
+                    "%d of %d shard writes failed; step %d not committed"
+                    % (num_shards - len(results), num_shards, step))
+            manifest = {
+                "version": MANIFEST_VERSION,
+                "step": int(step),
+                "dp": int(num_shards),
+                "fingerprint": fp,
+                "catalog": catalog,
+                "shards": [{"file": os.path.basename(p),
+                            "crc32": results[r][0], "bytes": results[r][1]}
+                           for r, p in enumerate(shard_paths)],
+                "opt_meta": opt_meta or {},
+            }
+            mblob = json.dumps(manifest, sort_keys=True, indent=1).encode()
+            _atomic_write(mpath, mblob)
+            _ckpt_bytes.inc(len(mblob))
+            _ckpt_total.inc()
+            _ckpt_last_ms.set((time.monotonic() - t0) * 1000.0)
+
+        # the commit edge: manifest op cannot run before any shard op
+        engine.push_file_write(mpath, _manifest_writer, wait=False,
+                               name="ckpt_manifest",
+                               after_paths=shard_paths)
+
+    handle = CheckpointHandle(prefix, step, shard_paths + [mpath])
+    if not async_write:
+        handle.wait()
+    return handle
+
+
+def list_steps(prefix: str) -> List[int]:
+    """Steps with a parseable, fully-present manifest, ascending."""
+    d = os.path.dirname(prefix) or "."
+    base = os.path.basename(prefix)
+    if not os.path.isdir(d):
+        return []
+    out = []
+    for fn in os.listdir(d):
+        if not (fn.startswith(base + "-step")
+                and fn.endswith(".manifest.json")):
+            continue
+        try:
+            step = int(fn[len(base) + 5:-len(".manifest.json")])
+        except ValueError:
+            continue
+        if _manifest_ok(prefix, step):
+            out.append(step)
+    return sorted(out)
+
+
+def _manifest_ok(prefix: str, step: int) -> bool:
+    try:
+        with open(_manifest_path(prefix, step)) as f:
+            m = json.load(f)
+        d = os.path.dirname(prefix) or "."
+        for sh in m["shards"]:
+            p = os.path.join(d, sh["file"])
+            if os.path.getsize(p) != sh["bytes"]:
+                return False
+        return m.get("version") == MANIFEST_VERSION
+    except (OSError, ValueError, KeyError):
+        return False
+
+
+def latest_step(prefix: str) -> Optional[int]:
+    """Newest committed step, or None. Only manifests whose shards are
+    all on disk at the recorded sizes count — a crash mid-commit leaves
+    the previous checkpoint authoritative."""
+    steps = list_steps(prefix)
+    return steps[-1] if steps else None
+
+
+class RestoredCheckpoint:
+    """What :func:`load_sharded` returns.
+
+    - ``arrays``: full (reassembled) name → ndarray dict
+    - ``shards``: per-rank dicts of 1-D slices at ``dp`` (= ``new_dp``
+      when given — the re-split view a resuming rank consumes)
+    - ``manifest`` / ``step`` / ``opt_meta`` / ``fingerprint``
+    """
+
+    def __init__(self, arrays, shards, manifest):
+        self.arrays: Dict[str, np.ndarray] = arrays
+        self.shards: List[Dict[str, np.ndarray]] = shards
+        self.manifest: dict = manifest
+        self.step: int = manifest["step"]
+        self.dp: int = len(shards)
+        self.opt_meta: dict = manifest.get("opt_meta", {})
+        self.fingerprint: str = manifest["fingerprint"]
+
+
+def load_sharded(prefix: str, step: Optional[int] = None,
+                 new_dp: Optional[int] = None,
+                 expect_fingerprint: Optional[str] = None
+                 ) -> RestoredCheckpoint:
+    """Load a committed checkpoint; reassemble (and optionally re-split).
+
+    ``step=None`` picks :func:`latest_step`. ``new_dp`` re-splits for a
+    different data-parallel width — a job checkpointed at dp=N resumes
+    at dp=M with every element bit-identical (contiguous ranges only
+    move between shards, they never change). CRCs and the catalog are
+    validated; ``expect_fingerprint`` guards against restoring into the
+    wrong model."""
+    if step is None:
+        step = latest_step(prefix)
+        if step is None:
+            raise MXNetError("no committed checkpoint under prefix %r"
+                             % prefix)
+    mpath = _manifest_path(prefix, step)
+    with _telemetry.span("resilience.restore", domain="resilience",
+                         step=step):
+        with open(mpath) as f:
+            manifest = json.load(f)
+        if manifest.get("version") != MANIFEST_VERSION:
+            raise MXNetError("manifest %s: unsupported version %r"
+                             % (mpath, manifest.get("version")))
+        if (expect_fingerprint is not None
+                and manifest["fingerprint"] != expect_fingerprint):
+            raise MXNetError(
+                "checkpoint fingerprint mismatch for %s: manifest %s != "
+                "expected %s (different model catalog)"
+                % (mpath, manifest["fingerprint"], expect_fingerprint))
+        d = os.path.dirname(prefix) or "."
+        pieces: List[Dict[str, np.ndarray]] = []
+        for sh in manifest["shards"]:
+            spath = os.path.join(d, sh["file"])
+            engine.wait_for_file(spath)  # never half-read an async write
+            with open(spath, "rb") as f:
+                data = f.read()
+            crc = zlib.crc32(data) & 0xFFFFFFFF
+            if crc != sh["crc32"]:
+                raise MXNetError(
+                    "shard %s corrupt: crc32 %08x != manifest %08x"
+                    % (spath, crc, sh["crc32"]))
+            pieces.append(_parse_shard(data, spath))
+
+        arrays: Dict[str, np.ndarray] = {}
+        for name, spec in manifest["catalog"].items():
+            flat = np.concatenate([p[name] for p in pieces])
+            shape = tuple(spec["shape"])
+            if flat.size != int(np.prod(shape) if shape else 1):
+                raise MXNetError(
+                    "shard reassembly of %r: got %d elements, catalog "
+                    "says %s" % (name, flat.size, shape))
+            arrays[name] = flat.reshape(shape).astype(spec["dtype"],
+                                                      copy=False)
+
+        dp = int(new_dp) if new_dp else int(manifest["dp"])
+        shards = []
+        for r in range(dp):
+            sd = {}
+            for name in sorted(arrays):
+                flat = arrays[name].reshape(-1)
+                lo, hi = _shard_range(flat.size, r, dp)
+                sd[name] = flat[lo:hi]
+            shards.append(sd)
+        _restore_total.inc()
+    return RestoredCheckpoint(arrays, shards, manifest)
+
+
+def reshard(prefix: str, step: int, new_dp: int,
+            out_prefix: Optional[str] = None,
+            async_write: bool = False) -> CheckpointHandle:
+    """Rewrite the checkpoint at ``new_dp`` shards (same step). The
+    dp=4 → dp=2 → dp=4 round-trip is bitwise on every tensor."""
+    rc = load_sharded(prefix, step)
+    return save_sharded(out_prefix or prefix, step, rc.arrays, new_dp,
+                        opt_meta=rc.opt_meta, fingerprint=rc.fingerprint,
+                        async_write=async_write)

@@ -1,0 +1,276 @@
+# coding: utf-8
+"""Deterministic, seed-driven fault injection (``MXNET_FAULT_PLAN``).
+
+Nothing in a healthy tree ever *exercises* a failure; this module makes
+failure a first-class, replayable input. A *fault plan* is a small
+``;``-separated DSL naming faults to inject at instrumented sites:
+
+    MXNET_FAULT_PLAN="seed=7; engine_error op=ckpt_shard nth=2; \
+kill_rank rank=1 step=5; conn_drop op=push nth=3; delay op=pull nth=2 ms=40"
+
+Entry grammar: ``kind k=v k=v ...``. Kinds and the sites that honour them:
+
+``engine_error op=<substr> [nth=K] [p=F]``
+    The matching engine-op / file-write raises :class:`InjectedFault`
+    (checkpoint writes consult :func:`maybe_raise` inside the op body, so
+    the error takes the REAL async-error path: ``engine._file_errs`` →
+    next sync point).
+``conn_drop op=<substr> [nth=K] [p=F]``
+    ``PSClient`` closes the socket mid-RPC and raises ``OSError`` — the
+    exact failure a killed server produces.
+``delay op=<substr> [nth=K] [p=F] ms=<float>``
+    The matching site sleeps ``ms`` before proceeding (reply-delay /
+    slow-network simulation).
+``kill_rank rank=R step=S``
+    From training step ``S`` on, rank ``R`` reads as dead
+    (:func:`killed_ranks`, merged into ``parallel.dist.dead_nodes``);
+    :func:`revive` models the rank's restart and consumes the entry.
+
+Matching is DETERMINISTIC: each entry keeps its own occurrence counter
+per matching site call; ``nth=K`` fires on the K-th match (1-based),
+once. ``p=F`` fires with probability F from the plan's seeded RNG —
+same seed, same plan, same call sequence ⇒ byte-identical fault
+schedule. Counters live under one leaf lock (``resilience.faults._lock``,
+rank 100 in the analysis LOCK_HIERARCHY): sites may be called from
+engine workers and the training thread concurrently.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+from ..base import MXNetError
+from .. import telemetry as _telemetry
+
+__all__ = ["InjectedFault", "install", "clear", "active", "plan_repr",
+           "maybe_raise", "maybe_drop", "maybe_delay",
+           "killed_ranks", "revive", "faults_injected"]
+
+
+class InjectedFault(MXNetError):
+    """An error raised on purpose by the fault plan (never by real code)."""
+
+
+_KINDS = ("engine_error", "conn_drop", "delay", "kill_rank")
+
+_counter = _telemetry.registry.counter(
+    "resilience_faults_injected_total",
+    help="Faults fired by the MXNET_FAULT_PLAN harness")
+
+
+class _Fault:
+    __slots__ = ("kind", "op", "nth", "p", "ms", "rank", "step",
+                 "seen", "fired")
+
+    def __init__(self, kind: str, kv: Dict[str, str], idx: int):
+        self.kind = kind
+        self.op = kv.pop("op", None)
+        self.nth = int(kv.pop("nth", "1"))
+        self.p = float(kv["p"]) if "p" in kv else None
+        kv.pop("p", None)
+        self.ms = float(kv.pop("ms", "0"))
+        self.rank = int(kv.pop("rank", "-1"))
+        self.step = int(kv.pop("step", "0"))
+        if kv:
+            raise ValueError("fault entry %d (%s): unknown key(s) %s"
+                             % (idx, kind, sorted(kv)))
+        if kind == "kill_rank" and self.rank < 0:
+            raise ValueError("kill_rank needs rank=R")
+        if kind == "delay" and self.ms <= 0:
+            raise ValueError("delay needs ms=<positive float>")
+        self.seen = 0    # matching site calls so far (under _lock)
+        self.fired = False
+
+    def describe(self) -> str:
+        bits = [self.kind]
+        if self.op is not None:
+            bits.append("op=%s" % self.op)
+        if self.kind == "kill_rank":
+            bits.append("rank=%d step=%d" % (self.rank, self.step))
+        elif self.p is not None:
+            bits.append("p=%g" % self.p)
+        else:
+            bits.append("nth=%d" % self.nth)
+        if self.kind == "delay":
+            bits.append("ms=%g" % self.ms)
+        return " ".join(bits)
+
+
+_lock = threading.Lock()          # leaf: rank 100, nothing acquired inside
+_plan: List[_Fault] = []
+_rng = random.Random(0)
+_env_loaded = False
+_revived: Set[int] = set()
+_injected = 0   # own tally: authoritative even when telemetry is disabled
+
+
+def _parse(text: str) -> tuple:
+    faults: List[_Fault] = []
+    seed = 0
+    for idx, raw in enumerate(text.split(";")):
+        entry = raw.strip()
+        if not entry:
+            continue
+        toks = entry.split()
+        if toks[0].startswith("seed="):
+            seed = int(toks[0][5:])
+            toks = toks[1:]
+            if not toks:
+                continue
+        kind = toks[0]
+        if kind not in _KINDS:
+            raise ValueError(
+                "fault entry %d: unknown kind %r (expected one of %s)"
+                % (idx, kind, "/".join(_KINDS)))
+        kv = {}
+        for t in toks[1:]:
+            if "=" not in t:
+                raise ValueError("fault entry %d: bad token %r (want k=v)"
+                                 % (idx, t))
+            k, v = t.split("=", 1)
+            kv[k] = v
+        faults.append(_Fault(kind, kv, idx))
+    return faults, seed
+
+
+def install(plan: Optional[str]):
+    """Install ``plan`` (the ``MXNET_FAULT_PLAN`` DSL) process-wide;
+    ``None``/empty clears. Resets all occurrence counters and the RNG."""
+    global _plan, _rng, _env_loaded, _revived, _injected
+    faults, seed = _parse(plan) if plan else ([], 0)
+    with _lock:
+        _plan = faults
+        _rng = random.Random(seed)
+        _revived = set()
+        _injected = 0
+        _env_loaded = True   # an explicit install overrides the env
+
+
+def clear():
+    """Remove the active plan (env plan will NOT be re-read)."""
+    install(None)
+
+
+def _ensure_loaded():
+    global _env_loaded
+    if _env_loaded:
+        return
+    with _lock:
+        if _env_loaded:
+            return
+        _env_loaded = True
+    env = os.environ.get("MXNET_FAULT_PLAN")
+    if env:
+        install(env)
+
+
+def active() -> bool:
+    """True when a non-empty plan is installed (or set via the env)."""
+    _ensure_loaded()
+    with _lock:
+        return bool(_plan)
+
+
+def plan_repr() -> List[str]:
+    """Human-readable entries of the active plan (for logs/tests)."""
+    _ensure_loaded()
+    with _lock:
+        return [f.describe() for f in _plan]
+
+
+def faults_injected() -> int:
+    """Total faults fired since the last :func:`install`."""
+    with _lock:
+        return _injected
+
+
+def _fired(n: int = 1):
+    global _injected
+    with _lock:
+        _injected += n
+    _counter.inc(n)   # counter has its own lock: inc OUTSIDE _lock (leaf)
+
+
+def _match(kind: str, op: Optional[str]) -> Optional[_Fault]:
+    """Find-and-arm under _lock; returns the fault iff it fires NOW."""
+    _ensure_loaded()
+    if not _plan:  # fast path: no plan, no lock (GIL-safe read)
+        return None
+    with _lock:
+        for f in _plan:
+            if f.kind != kind or f.fired:
+                continue
+            if f.op is not None and (op is None or f.op not in op):
+                continue
+            f.seen += 1
+            if f.p is not None:
+                if _rng.random() >= f.p:
+                    continue
+            elif f.seen != f.nth:
+                continue
+            f.fired = True
+            return f
+    return None
+
+
+def maybe_raise(op: str):
+    """Site hook for ``engine_error``: raise :class:`InjectedFault` when
+    the plan says so. Call INSIDE the op body so the error takes the same
+    propagation path a real failure would."""
+    f = _match("engine_error", op)
+    if f is not None:
+        _fired()
+        raise InjectedFault("injected engine_error at op %r (%s)"
+                            % (op, f.describe()))
+
+
+def maybe_drop(op: str) -> bool:
+    """Site hook for ``conn_drop``: True when the caller should sever its
+    connection and raise the resulting OSError itself."""
+    f = _match("conn_drop", op)
+    if f is not None:
+        _fired()
+        return True
+    return False
+
+
+def maybe_delay(op: str):
+    """Site hook for ``delay``: sleep the planned ms when matched."""
+    f = _match("delay", op)
+    if f is not None:
+        _fired()
+        time.sleep(f.ms / 1000.0)
+
+
+def killed_ranks(step: Optional[int] = None) -> Set[int]:
+    """Ranks the plan declares dead at training step ``step`` (all armed
+    kills when ``step`` is None), minus ranks revived since. Feeds
+    ``parallel.dist.dead_nodes`` so the supervisor's normal dead-node
+    poll sees simulated deaths through the same surface as real ones."""
+    _ensure_loaded()
+    out: Set[int] = set()
+    newly_fired = 0
+    with _lock:
+        for f in _plan:
+            if f.kind != "kill_rank" or f.rank in _revived:
+                continue
+            if step is None or step >= f.step:
+                if not f.fired:
+                    f.fired = True
+                    newly_fired += 1
+                out.add(f.rank)
+    if newly_fired:
+        _fired(newly_fired)
+    return out
+
+
+def revive(rank: int):
+    """Model the dead rank's restart: it stops reading as dead. The
+    supervisor calls this once recovery has restored state — a second
+    ``kill_rank`` entry for the same rank would fire afresh only via a
+    new :func:`install`."""
+    with _lock:
+        _revived.add(rank)

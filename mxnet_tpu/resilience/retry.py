@@ -1,0 +1,133 @@
+# coding: utf-8
+"""Unified retry policy: jittered exponential backoff under a deadline.
+
+One policy object replaces the hand-rolled ``while True: try/except/
+sleep(0.2)`` connect loops that grew in ``kvstore_server.py`` (and that
+each read ``MXNET_TPU_PS_CONNECT_TIMEOUT`` independently). The shape
+follows ps-lite's resender/backoff knobs: a base delay doubling per
+attempt up to a cap, multiplied by a deterministic jitter so N workers
+hammering a restarting server don't reconnect in lockstep, all bounded
+by a wall-clock deadline.
+
+Env defaults (docs/env_var.md "Distributed"):
+
+- ``MXNET_TPU_PS_CONNECT_TIMEOUT`` — deadline seconds (default 60)
+- ``MXNET_TPU_PS_RETRY_BASE``      — first backoff seconds (default 0.2)
+- ``MXNET_TPU_PS_RETRY_MAX``       — backoff cap seconds (default 2.0)
+- ``MXNET_TPU_PS_RETRY_JITTER``    — jitter fraction in [0,1) (default 0.25)
+"""
+from __future__ import annotations
+
+import os
+import random
+import time
+from typing import Callable, Iterator, Optional, Tuple, Type
+
+from ..base import MXNetError
+
+__all__ = ["RetryPolicy", "RetryError"]
+
+
+class RetryError(MXNetError):
+    """Deadline exhausted; ``last_error`` holds the final attempt's failure."""
+
+    def __init__(self, msg: str, last_error: Optional[BaseException] = None):
+        super().__init__(msg)
+        self.last_error = last_error
+
+
+class RetryPolicy:
+    """Jittered exponential backoff bounded by a deadline.
+
+    Use either the iterator form (the attempt body stays in caller code,
+    matching the old inline loops)::
+
+        for attempt in RetryPolicy.for_connect().attempts():
+            try:
+                conn = Client(addr, authkey=_AUTH)
+                break
+            except OSError:
+                continue          # attempts() sleeps, then re-yields
+
+    or the functional form::
+
+        conn = RetryPolicy.for_connect().call(
+            lambda: Client(addr, authkey=_AUTH), retry_on=(OSError,))
+
+    Both raise :class:`RetryError` once the deadline passes, chaining the
+    last attempt's exception. ``seed`` pins the jitter sequence — the
+    fault-injection tests rely on byte-identical schedules per seed.
+    """
+
+    def __init__(self, deadline_s: float = 60.0, base_s: float = 0.2,
+                 max_s: float = 2.0, jitter: float = 0.25,
+                 seed: Optional[int] = None):
+        if deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0 (got %r)" % deadline_s)
+        if base_s <= 0 or max_s < base_s:
+            raise ValueError("need 0 < base_s <= max_s (got %r, %r)"
+                             % (base_s, max_s))
+        if not 0.0 <= jitter < 1.0:
+            raise ValueError("jitter must be in [0, 1) (got %r)" % jitter)
+        self.deadline_s = float(deadline_s)
+        self.base_s = float(base_s)
+        self.max_s = float(max_s)
+        self.jitter = float(jitter)
+        self._rng = random.Random(seed)
+
+    @classmethod
+    def for_connect(cls, seed: Optional[int] = None) -> "RetryPolicy":
+        """The PS connect policy, from the ``MXNET_TPU_PS_*`` env knobs.
+
+        THE single reader of ``MXNET_TPU_PS_CONNECT_TIMEOUT`` — every
+        site that used to parse it inline now builds one of these."""
+        return cls(
+            deadline_s=float(os.environ.get(
+                "MXNET_TPU_PS_CONNECT_TIMEOUT", "60")),
+            base_s=float(os.environ.get("MXNET_TPU_PS_RETRY_BASE", "0.2")),
+            max_s=float(os.environ.get("MXNET_TPU_PS_RETRY_MAX", "2.0")),
+            jitter=float(os.environ.get("MXNET_TPU_PS_RETRY_JITTER", "0.25")),
+            seed=seed)
+
+    def backoffs(self) -> Iterator[float]:
+        """The raw sleep schedule: base*2^k clamped to max, each scaled by
+        ``1 - jitter*u`` (u uniform in [0,1)) so jittered sleeps only ever
+        SHORTEN the wait — the deadline stays an upper bound."""
+        delay = self.base_s
+        while True:
+            j = 1.0 - self.jitter * self._rng.random()
+            yield delay * j
+            delay = min(delay * 2.0, self.max_s)
+
+    def attempts(self) -> Iterator[int]:
+        """Yield attempt indices 0, 1, 2, ... sleeping the backoff between
+        them, until the deadline passes; the final yield happens exactly at
+        deadline expiry so the last attempt can still succeed. The caller
+        ``break``s on success; exhausting the iterator means every attempt
+        inside the window failed (raise or fall through as appropriate)."""
+        deadline = time.monotonic() + self.deadline_s
+        sched = self.backoffs()
+        k = 0
+        while True:
+            yield k
+            k += 1
+            now = time.monotonic()
+            if now >= deadline:
+                return
+            time.sleep(min(next(sched), max(0.0, deadline - now)))
+
+    def call(self, fn: Callable[[], object],
+             retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+             what: str = "operation"):
+        """Run ``fn`` under the policy; return its result. Exceptions not
+        in ``retry_on`` propagate immediately (they are bugs, not flakes)."""
+        last: Optional[BaseException] = None
+        for _ in self.attempts():
+            try:
+                return fn()
+            except retry_on as e:
+                last = e
+        raise RetryError(
+            "%s failed for %.1fs (last error: %s: %s)"
+            % (what, self.deadline_s, type(last).__name__ if last else "?",
+               last), last_error=last)

@@ -1,0 +1,220 @@
+# coding: utf-8
+"""Training supervisor: poll for dead ranks, restore, resume.
+
+``TrainingSupervisor.run(batch_fn, num_steps)`` owns the train loop a
+preemption-survivable job needs:
+
+- every ``checkpoint_interval`` completed steps it snapshots the
+  module's full training state (f32 masters + aux + optimizer state +
+  update counts, ``Module.get_checkpoint_state``) and commits it as an
+  async sharded checkpoint (``checkpoint.save_sharded``) — the loop
+  never blocks on disk;
+- between steps it polls the failure surfaces: ``kvstore.num_dead_node``
+  (PS heartbeats), ``parallel.dist.dead_nodes`` (which folds in
+  ``MXNET_FAULT_PLAN`` simulated kills), and engine-op errors observed
+  via ``engine.set_error_handler``;
+- on a detected death it pauses, drains in-flight checkpoint writes
+  (a fault-injected write failure just means that checkpoint never
+  committed — the previous manifest stays authoritative), restores the
+  newest committed checkpoint into the module, revives the simulated
+  rank, and resumes from the restored step.
+
+Because ``batch_fn(step)`` is deterministic (replayable by step index —
+the contract MXNet's epoch-seeded DataIter reset gives for free), a
+recovered run replays the lost steps exactly and its per-step weights
+are step-level equivalent to an uninterrupted run; the kill-a-rank
+dryrun (CI stage "fault") asserts precisely that.
+
+Single-threaded by design: polling happens BETWEEN steps on the
+training thread, so the supervisor needs no lock of its own (the
+engine error hook only appends to a list under the GIL).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional, Set
+
+from .. import engine
+from .. import telemetry as _telemetry
+from ..base import MXNetError
+from ..parallel import dist
+from . import checkpoint as _ckpt
+from . import faults
+from .retry import RetryPolicy
+
+__all__ = ["TrainingSupervisor", "RecoveryError"]
+
+_log = logging.getLogger("mxnet_tpu")
+
+_recoveries = _telemetry.registry.counter(
+    "resilience_recoveries_total",
+    help="Successful dead-rank recoveries (restore + resume)")
+
+
+class RecoveryError(MXNetError):
+    """Recovery impossible (no committed checkpoint / budget exhausted)."""
+
+
+class TrainingSupervisor:
+    """Elastic train-loop wrapper for a bound+initialized ``Module``.
+
+    Parameters
+    ----------
+    module : Module — bound, params + optimizer initialized.
+    prefix : checkpoint path prefix (directory must exist).
+    checkpoint_interval : commit every N completed steps (default 10).
+    num_shards : shard fan-out; default = the module's device count.
+    kvstore : optional KVStore whose ``num_dead_node`` joins the poll.
+    poll_every : poll the failure surfaces every N steps (default 1).
+    async_write : overlap checkpoint IO with training (default True).
+    max_recoveries : give up (RecoveryError) after this many restores.
+    retry : RetryPolicy for the restore itself (transient-IO armor).
+    """
+
+    def __init__(self, module, prefix: str, *,
+                 checkpoint_interval: int = 10,
+                 num_shards: Optional[int] = None,
+                 kvstore=None, poll_every: int = 1,
+                 async_write: bool = True, max_recoveries: int = 3,
+                 retry: Optional[RetryPolicy] = None):
+        if checkpoint_interval < 1:
+            raise ValueError("checkpoint_interval must be >= 1")
+        self._module = module
+        self._prefix = prefix
+        self._interval = int(checkpoint_interval)
+        self._num_shards = int(num_shards
+                               or len(getattr(module, "_context", [0])))
+        self._kvstore = kvstore
+        self._poll_every = max(1, int(poll_every))
+        self._async = bool(async_write)
+        self._max_recoveries = int(max_recoveries)
+        self._retry = retry or RetryPolicy(deadline_s=10.0, base_s=0.05,
+                                           max_s=0.5, seed=0)
+        self._fingerprint: Optional[str] = None
+        self._handles: List[_ckpt.CheckpointHandle] = []
+        self._op_errors: List[tuple] = []
+        self.recoveries = 0
+        self.checkpoints = 0
+
+    # --- failure surfaces -------------------------------------------------
+    def _dead(self, step: int) -> Set[int]:
+        dead = set(dist.dead_nodes(step))
+        kv = self._kvstore
+        if kv is not None:
+            try:
+                if kv.num_dead_node(timeout_sec=0) > 0:
+                    dead.add(-1)  # PS-reported death (rank unknown here)
+            except TypeError:
+                if kv.num_dead_node(0) > 0:
+                    dead.add(-1)
+        return dead
+
+    def _on_op_error(self, name: str, exc: BaseException):
+        # runs ON an engine worker: just record (list.append is atomic);
+        # the training thread acts at its next poll. Checkpoint-write
+        # failures are NOT failures of the run — they surface (and are
+        # tolerated) through the file-error path on drain instead.
+        if not name.startswith("ckpt_"):
+            self._op_errors.append((name, exc))
+
+    # --- checkpointing ----------------------------------------------------
+    def checkpoint_now(self, step: int) -> _ckpt.CheckpointHandle:
+        """Snapshot + commit (async unless configured otherwise)."""
+        arrays, opt_meta = self._module.get_checkpoint_state()
+        if self._fingerprint is None:
+            self._fingerprint = _ckpt.fingerprint_arrays(arrays)
+        h = _ckpt.save_sharded(self._prefix, step, arrays,
+                               self._num_shards, opt_meta=opt_meta,
+                               fingerprint=self._fingerprint,
+                               async_write=self._async)
+        self._handles.append(h)
+        self.checkpoints += 1
+        return h
+
+    def _drain_writes(self):
+        """Wait out in-flight checkpoint writes; a failed write only
+        means that checkpoint never committed."""
+        for h in self._handles:
+            try:
+                h.wait()
+            except BaseException as e:
+                _log.warning("supervisor: checkpoint step %d failed "
+                             "(not committed): %s", h.step, e)
+        self._handles = []
+
+    # --- recovery ---------------------------------------------------------
+    def _recover(self, dead: Set[int], at_step: int) -> int:
+        self.recoveries += 1
+        if self.recoveries > self._max_recoveries:
+            raise RecoveryError(
+                "recovery budget exhausted (%d) — dead ranks %s at step %d"
+                % (self._max_recoveries, sorted(dead), at_step))
+        with _telemetry.span("resilience.recover", domain="resilience",
+                             step=at_step, dead=len(dead)):
+            _log.warning("supervisor: dead rank(s) %s detected at step %d"
+                         " — pausing for restore", sorted(dead), at_step)
+            self._drain_writes()
+            self._op_errors = []
+            committed = _ckpt.latest_step(self._prefix)
+            if committed is None:
+                raise RecoveryError(
+                    "no committed checkpoint under %r to restore from"
+                    % self._prefix)
+            rc = self._retry.call(
+                lambda: _ckpt.load_sharded(
+                    self._prefix, committed, new_dp=self._num_shards,
+                    expect_fingerprint=self._fingerprint),
+                retry_on=(OSError,), what="checkpoint restore")
+            self._module.restore_checkpoint_state(rc.arrays, rc.opt_meta)
+            for r in dead:
+                if r >= 0:
+                    faults.revive(r)
+            _recoveries.inc()
+            _log.warning("supervisor: restored step %d, resuming",
+                         committed)
+        return committed
+
+    # --- the loop ---------------------------------------------------------
+    def run(self, batch_fn: Callable[[int], object], num_steps: int,
+            begin_step: int = 0) -> int:
+        """Run steps ``begin_step..num_steps-1`` with supervision;
+        returns the number of completed steps. ``batch_fn(step)`` must
+        be deterministic in ``step`` — recovery replays lost steps.
+
+        If a committed checkpoint newer than ``begin_step`` already
+        exists under the prefix (a restarted process), training resumes
+        from it instead of ``begin_step``."""
+        completed = begin_step
+        existing = _ckpt.latest_step(self._prefix)
+        if existing is not None and existing > completed:
+            rc = _ckpt.load_sharded(self._prefix, existing,
+                                    new_dp=self._num_shards)
+            self._fingerprint = rc.fingerprint
+            self._module.restore_checkpoint_state(rc.arrays, rc.opt_meta)
+            completed = existing
+            _log.info("supervisor: resuming from committed step %d",
+                      completed)
+        prev_handler = engine.set_error_handler(self._on_op_error)
+        try:
+            if existing is None:
+                # a restore point must exist before the first failure
+                self.checkpoint_now(completed).wait()
+            while completed < num_steps:
+                if (completed % self._poll_every) == 0 or self._op_errors:
+                    dead = self._dead(completed)
+                    if self._op_errors:
+                        _log.warning("supervisor: engine op error(s) %s",
+                                     [n for n, _ in self._op_errors])
+                        dead.add(-1)
+                    if dead:
+                        completed = self._recover(dead, completed)
+                        continue
+                self._module.fit_step(batch_fn(completed))
+                completed += 1
+                if (completed % self._interval == 0
+                        or completed == num_steps):
+                    self.checkpoint_now(completed)
+            self._drain_writes()
+        finally:
+            engine.set_error_handler(prev_handler)
+        return completed

@@ -16,6 +16,9 @@ Failure is structured: every way a request can fail carries a
                          never be dispatched, so submit rejects it
 - ``deadline_exceeded``  the request expired before dispatch
 - ``shutdown``           the server stopped while the request was queued
+- ``shutting_down``      the server is draining (``stop(drain=True)``):
+                         new submits are refused, and requests still
+                         queued when the drain deadline passes fail too
 - ``dispatch_error``     the compiled executor raised; the batch's requests
                          all carry the cause
 - ``wait_timeout``       ``Request.get(timeout)`` gave up waiting
@@ -123,6 +126,7 @@ class BatchFormer:
         self._rows = 0  # queued rows (cached sum over self._q)
         self._cond = threading.Condition()
         self._closed = False
+        self._close_code = "shutdown"  # what post-close submits raise
 
     def _fail(self, req: Request, err: ServingError):
         req.set_error(err)
@@ -137,7 +141,10 @@ class BatchFormer:
                 "too_large")
         with self._cond:
             if self._closed:
-                raise ServingError("server is shut down", "shutdown")
+                raise ServingError(
+                    "server is shut down" if self._close_code == "shutdown"
+                    else "server is draining for shutdown",
+                    self._close_code)
             if len(self._q) >= self.queue_depth:
                 raise ServingError(
                     "queue full (%d requests; MXNET_SERVING_QUEUE_DEPTH)"
@@ -155,10 +162,13 @@ class BatchFormer:
         with self._cond:
             return self._closed
 
-    def close(self):
-        """Stop admitting; wake the former loop so it can drain and exit."""
+    def close(self, code: str = "shutdown"):
+        """Stop admitting; wake the former loop so it can drain and exit.
+        ``code`` is what later submits raise (``"shutting_down"`` during
+        a graceful drain, ``"shutdown"`` once stopped)."""
         with self._cond:
             self._closed = True
+            self._close_code = code
             self._cond.notify_all()
 
     def fail_pending(self, code: str = "shutdown",

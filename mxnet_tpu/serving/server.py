@@ -239,10 +239,17 @@ class InferenceServer:
         self._thread.start()
         return self
 
-    def stop(self, drain: bool = True):
-        """Stop the server. ``drain=True`` serves everything already
-        queued first; ``drain=False`` fails queued requests with a
-        ``shutdown`` ServingError. In-flight dispatches always finish."""
+    def stop(self, drain: bool = True,
+             deadline_ms: Optional[float] = None):
+        """Stop the server. ``drain=True`` is the graceful path: new
+        submits fail immediately with code ``shutting_down`` while
+        everything already queued keeps being served — up to
+        ``deadline_ms`` (default ``MXNET_SERVING_DRAIN_DEADLINE_MS``;
+        unset = drain fully), after which still-queued requests fail
+        with ``shutting_down`` too. ``drain=False`` fails queued
+        requests right away with a ``shutdown`` ServingError. In-flight
+        dispatches always finish either way. Once ``stop`` returns the
+        server is plain stopped: later submits raise ``shutdown``."""
         if not self._started:
             self._former.close()
             self._former.fail_pending()
@@ -250,9 +257,25 @@ class InferenceServer:
         if not drain:
             self._former.close()
             self._former.fail_pending()
+            self._thread.join()
         else:
-            self._former.close()
-        self._thread.join()
+            if deadline_ms is None:
+                env = os.environ.get("MXNET_SERVING_DRAIN_DEADLINE_MS", "")
+                deadline_ms = float(env) if env else None
+            self._former.close(code="shutting_down")
+            self._thread.join(None if deadline_ms is None
+                              else max(0.0, deadline_ms) / 1e3)
+            if self._thread.is_alive():
+                # deadline passed: give up on what is still queued
+                # (in-flight batches below still complete on their vars)
+                self._former.fail_pending(
+                    code="shutting_down",
+                    msg="drain deadline (%g ms) passed with the request "
+                        "still queued" % deadline_ms)
+                self._thread.join()
+            # drain over: submits now race a *stopped* server, not a
+            # draining one — re-stamp the terminal code
+            self._former.close(code="shutdown")
         for rep in self._replicas:
             engine.wait_for_var(rep.var)
             engine.untrack_inflight(rep.var)

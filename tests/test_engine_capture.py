@@ -90,7 +90,10 @@ def test_unstable_warmup_bails_to_eager_with_logged_reason(caplog):
             cs.end_step()
     engine.fence(vs).wait(30)
     assert cs.state == "eager" and cs.replays == 0
-    assert out == [0, 1, 2, 3]  # every op still ran, eagerly
+    # every op still ran, eagerly; same-var pushes keep WAW order, but
+    # ops on vs[0] vs vs[1] may interleave across the worker pool
+    assert sorted(out) == [0, 1, 2, 3]
+    assert out.index(0) < out.index(2) and out.index(1) < out.index(3)
     assert any("unstable" in r.message for r in caplog.records)
     # invalidate() is the one exit from bailed-eager
     cs.invalidate("topology settled")
@@ -117,7 +120,11 @@ def test_replay_mismatch_flushes_prefix_in_order_then_recaptures():
     cs.push(lambda: out.append(("X", 99)), mutable_vars=[vs[1]], name="X")
     cs.end_step()
     engine.fence(vs).wait(30)
-    assert out[-2:] == [("a", 99), ("X", 99)]
+    # a and X write independent vars, so only dependency order is
+    # guaranteed: both ran strictly after the last replay (they WAW/WAR
+    # its union var set), i.e. they are the last two entries — in either
+    # relative order under the concurrent worker pool
+    assert set(out[-2:]) == {("a", 99), ("X", 99)}
     assert cs.state == "capture" and cs.bails == 1
     # a short iteration (fewer ops than recorded) also flushes + recaptures
     for it in range(2):
@@ -127,7 +134,9 @@ def test_replay_mismatch_flushes_prefix_in_order_then_recaptures():
     cs.push(lambda: out.append(("a", 200)), mutable_vars=[vs[0]], name="a")
     cs.end_step()
     engine.fence(vs).wait(30)
-    assert out[-1] == ("a", 200)
+    # ("a", 200) WAW/WAR-chains behind iteration 101's a and b, but NOT
+    # its c (vs[2] writer) — it can only race that one op
+    assert ("a", 200) in out[-2:]
     assert cs.state == "capture" and cs.bails == 2
     for v in vs:
         engine.delete_variable(v)

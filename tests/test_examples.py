@@ -342,7 +342,11 @@ def test_sgld_example():
 def test_stochastic_depth_example():
     """Per-batch Bernoulli block gating fed as data streams (the XLA-native
     form of stochastic depth's random layer skip)."""
-    out = _run("examples/stochastic-depth/sd_mnist.py", "--steps", "60")
+    # 120 steps, not 60: XLA CPU reductions are nondeterministic across
+    # runs and the training trajectory amplifies the noise — the longer
+    # run converges with a comfortable margin over the 0.9 bar on every
+    # trajectory, where 60 steps occasionally landed just under it
+    out = _run("examples/stochastic-depth/sd_mnist.py", "--steps", "120")
     assert "stochastic-depth OK" in out
 
 
