@@ -835,6 +835,106 @@ def run_checkpoint_config():
     }
 
 
+def run_progcache_config():
+    """Persistent-program-cache warm-restart A/B (BENCH_MODEL=progcache):
+    time-to-first-response of a freshly built serving ladder (Predictor +
+    BucketCache.warm + one forward, the restart path) with the cache
+    disabled (cold arm: every bucket is a fresh XLA compile) vs enabled
+    over a pre-populated dir (warm arm: every bucket is a disk load).
+    The arms run BACK-TO-BACK inside each repeat and value = the median
+    of the per-repeat paired ratios (the checkpoint bench's drift-
+    cancelling scheme — cold and warm measured minutes apart would swing
+    by more than the gate). The ISSUE 8 gate is warm ttfr >= 3x faster,
+    so vs_baseline = value / 3.0 (>= 1.0 passes)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from mxnet_tpu import predict
+    from mxnet_tpu.serving.bucket_cache import BucketCache
+
+    sym, params, in_dim, hidden, classes = _serving_model()
+    buckets = tuple(int(b) for b in os.environ.get(
+        "BENCH_PROGCACHE_BUCKETS", "33,36").split(","))
+    repeats = max(1, int(os.environ.get("BENCH_PROGCACHE_REPEATS", "5")))
+    smallest = buckets[0]
+    rng = np.random.RandomState(3)
+    x = rng.uniform(-1, 1, (buckets[-1], in_dim)).astype(np.float32)
+    symbol_json = sym.tojson()
+
+    cachedir = tempfile.mkdtemp(prefix="mxtpu_progcache_bench_")
+    saved = {k: os.environ.get(k)
+             for k in ("MXNET_PROGCACHE", "MXNET_PROGCACHE_DIR")}
+
+    def set_env(warm):
+        if warm:
+            os.environ.pop("MXNET_PROGCACHE", None)
+            os.environ["MXNET_PROGCACHE_DIR"] = cachedir
+        else:
+            os.environ["MXNET_PROGCACHE"] = "0"  # kill switch: true cold
+            os.environ.pop("MXNET_PROGCACHE_DIR", None)
+
+    def arm(warm):
+        """Rebuild the whole ladder from scratch (fresh Predictor — fresh
+        closures, so jax's in-process jit cache cannot leak programs
+        between repeats) and serve one request. Returns (ttfr_s, build_s,
+        first_out, stats)."""
+        set_env(warm)
+        t0 = time.perf_counter()
+        base = predict.Predictor(symbol_json, params,
+                                 {"data": (smallest, in_dim)})
+        cache = BucketCache(base, buckets)
+        cache.warm()
+        t1 = time.perf_counter()
+        out = cache.get(buckets[-1]).forward(data=x)[0].asnumpy()
+        t2 = time.perf_counter()
+        return t2 - t0, t1 - t0, out, cache.stats()
+
+    try:
+        arm(True)  # populate the cache once (not timed)
+        cold_t, warm_t, cold_build, warm_build = [], [], [], []
+        out_c = out_w = None
+        for _ in range(repeats):
+            tc, bc, out_c, st_c = arm(False)
+            tw, bw, out_w, st_w = arm(True)
+            assert st_c["disk_hits"] == 0, st_c
+            assert st_w["compiles"] == 0, \
+                "warm restart performed fresh compiles: %s" % st_w
+            cold_t.append(tc)
+            warm_t.append(tw)
+            cold_build.append(bc)
+            warm_build.append(bw)
+        bitwise = bool((out_c == out_w).all())
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(cachedir, ignore_errors=True)
+
+    speedup = statistics.median(c / w for c, w in zip(cold_t, warm_t))
+    return {
+        "metric": "progcache_warm_restart",
+        "value": round(speedup, 2),
+        "unit": "x_time_to_first_response_cold_over_warm",
+        # the >=3x gate: >= 1.0 passes
+        "vs_baseline": round(speedup / 3.0, 3),
+        "cold_ttfr_ms": round(statistics.median(cold_t) * 1e3, 1),
+        "warm_ttfr_ms": round(statistics.median(warm_t) * 1e3, 1),
+        # build_s is the ladder-construction part of ttfr: all of it is
+        # compile time in the cold arm, disk-load time in the warm arm
+        "cold_compile_s_total": round(statistics.median(cold_build), 4),
+        "warm_load_s_total": round(statistics.median(warm_build), 4),
+        "bitwise_identical": bitwise,
+        "buckets": list(buckets),
+        "model": "MLP %d-%d-%d" % (in_dim, hidden, classes),
+        "repeats": repeats,
+        "timing": "median of %d paired cold/warm ttfr ratios, arms "
+                  "back-to-back per repeat" % repeats,
+    }
+
+
 def main():
     try:
         _main()
@@ -853,6 +953,9 @@ def _main():
         return
     if which == "checkpoint":
         _emit(run_checkpoint_config())
+        return
+    if which == "progcache":
+        _emit(run_progcache_config())
         return
     if os.environ.get("BENCH_LM_SWEEP"):
         # transformer (bs, seq) MFU table (docs/perf.md); one JSON line

@@ -44,6 +44,11 @@ echo "== stage 5: serving tests (dynamic batching + bucketed compile cache) =="
 # dry-run: concurrent clients -> occupancy/cache-hit assertions.
 JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
 JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; g.dryrun_serving()"
+# Warm-restart gate (persistent progcache): a cold process populates the
+# cache and tunes its ladder, then a SECOND process over the same cache
+# dir must serve the same traffic with 0 fresh bucket compiles (ladder
+# disk-loaded before traffic) and bitwise-identical outputs.
+JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; g.dryrun_progcache()"
 
 echo "== stage 6: import hygiene =="
 python - <<'EOF'
@@ -54,7 +59,7 @@ assert mx.libinfo.find_lib_path()
 print("import OK; ops:", len(mx.ops.registry.OP_REGISTRY))
 EOF
 
-echo "== stage 7: static analysis (lock-order / engine-discipline / trace-purity) =="
+echo "== stage 7: static analysis (lock-order / engine / purity / progcache-io) =="
 # Pure-AST gate, independent of the pytest tiers: the shipped tree must
 # produce no findings beyond ci/analysis_baseline.json (each baselined
 # entry carries a written justification). Fails on ANY new finding.
@@ -62,7 +67,7 @@ JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis --fail-on-new
 # Self-check: the known-bad fixtures must trip the gate (a silently
 # lobotomized analyzer would otherwise pass CI forever).
 for bad in abba_deadlock undeclared_mutable impure_jit telemetry_in_jit \
-        capture_unstable; do
+        capture_unstable raw_write_progcache; do
     if JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis \
             --root "tests/fixtures/analysis/${bad}.py" \
             --baseline none --fail-on-new >/dev/null 2>&1; then

@@ -23,6 +23,7 @@ _PREDICT_ONLY = _os.environ.get("MXNET_PREDICT_ONLY", "") not in ("", "0")
 
 from . import executor
 from .executor import Executor
+from . import progcache
 from . import predict
 from . import serving
 from . import telemetry

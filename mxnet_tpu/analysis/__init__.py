@@ -9,6 +9,10 @@ Three pure-``ast`` checkers (no module under analysis is imported):
 - :mod:`.trace_purity`  impure calls and state mutation inside
                         jit/shard_map-traced functions and pure_callback
                         callbacks
+- :mod:`.progcache_io`  persistent-cache commit discipline: every write
+                        in a progcache module goes through the atomic
+                        tmp+``os.replace`` helper (no raw
+                        ``open(path, 'wb')`` commits)
 
 Run ``python -m mxnet_tpu.analysis --fail-on-new`` (the CI gate) or use
 :func:`run_analysis` programmatically. Findings carry stable fingerprints;
@@ -24,14 +28,14 @@ from .core import (Finding, SourceModule, dedupe, diff_against_baseline,
 from .lockorder import LOCK_HIERARCHY
 from .witness import LockOrderWitness
 
-CHECKERS = ("lockorder", "engine", "purity")
+CHECKERS = ("lockorder", "engine", "purity", "progcache_io")
 
 
 def run_analysis(root: str,
                  checks: Optional[Sequence[str]] = None) -> List[Finding]:
     """Run the selected checkers (default: all) over every ``*.py`` under
     ``root`` and return deduped, location-sorted findings."""
-    from . import engine_lint, lockorder, trace_purity
+    from . import engine_lint, lockorder, progcache_io, trace_purity
     checks = tuple(checks) if checks else CHECKERS
     modules = load_modules(root)
     findings: List[Finding] = []
@@ -41,6 +45,8 @@ def run_analysis(root: str,
         findings += engine_lint.check(modules)
     if "purity" in checks:
         findings += trace_purity.check(modules)
+    if "progcache_io" in checks:
+        findings += progcache_io.check(modules)
     return dedupe(findings)
 
 

@@ -81,6 +81,10 @@ LOCK_HIERARCHY: Dict[str, int] = {
     # fault-injection plan table: leaf — match/fire bookkeeping only; the
     # telemetry counter inc happens after release (docs/fault_tolerance.md).
     "resilience.faults._lock": 100,
+    # persistent program cache: leaf — guards manifest read-modify-write
+    # and the session stat dict only; executable serialization, entry
+    # commits, and telemetry increments happen outside holds of it.
+    "progcache._lock": 100,
     "torch._TH_LOCK": 90,
     "io.DevicePrefetchIter._lock": 100,
     "random._lock": 100,

@@ -1,0 +1,89 @@
+"""Persistent-cache commit discipline lint.
+
+The progcache contract (docs/deployment.md "Warm restarts") is that a
+crash at ANY instruction can never leave a torn file at a committed name:
+every write must stage to a temp file and publish with ``os.replace``,
+the same idiom as ``resilience.checkpoint``. A raw
+``open(path, "wb")``-and-write at the committed name silently breaks the
+contract — a reader in another process sees a half-entry, and while the
+CRC check turns that into a fallback-compile rather than a wrong answer,
+it costs the warm restart the entry forever. Rules:
+
+- ``raw-binary-commit``   a write-mode ``open()`` call in a progcache
+                          module OUTSIDE an ``_atomic_write*`` helper —
+                          commits must go through the tmp+``os.replace``
+                          helper, not write in place
+
+Scoped to modules whose filename ends with ``progcache.py`` (the cache
+implementation, wherever it lives); read-mode opens are untouched.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence
+
+from .core import Finding, SourceModule
+
+#: any of these characters in the mode string means the open can create
+#: or destroy content at the target path
+_WRITE_MODES = frozenset("wax+")
+
+
+def _open_mode(call: ast.Call) -> Optional[str]:
+    """The literal mode of an ``open()`` call, '' when defaulted, or None
+    when the call is not an open / the mode is not a literal (dynamic
+    modes are flagged conservatively by returning them as 'w')."""
+    f = call.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else None)
+    if name != "open":
+        return None
+    mode_node: Optional[ast.AST] = call.args[1] if len(call.args) > 1 \
+        else None
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode_node = kw.value
+    if mode_node is None:
+        return ""
+    if isinstance(mode_node, ast.Constant) and \
+            isinstance(mode_node.value, str):
+        return mode_node.value
+    return "w"  # non-literal mode: assume the worst
+
+
+def check(modules: Sequence[SourceModule]) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in modules:
+        if not m.relpath.endswith("progcache.py"):
+            continue
+        # stack of (enclosing function name or "") while walking
+        def walk(node: ast.AST, fn_stack: List[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    walk(child, fn_stack + [child.name])
+                    continue
+                if isinstance(child, ast.Call):
+                    mode = _open_mode(child)
+                    if mode is not None and (set(mode) & _WRITE_MODES):
+                        inside_atomic = any(
+                            f.startswith("_atomic_write")
+                            for f in fn_stack)
+                        if not inside_atomic:
+                            qual = ".".join(fn_stack)
+                            findings.append(Finding(
+                                checker="progcache_io",
+                                rule="raw-binary-commit",
+                                path=m.relpath,
+                                line=child.lineno,
+                                qualname=("%s:%s" % (m.modname, qual)
+                                          if qual else m.modname),
+                                subject="open(mode=%r)" % mode,
+                                message="write-mode open() outside an "
+                                        "_atomic_write* helper — commit "
+                                        "via tmp + os.replace so a crash "
+                                        "can never tear a cache entry at "
+                                        "its committed name"))
+                walk(child, fn_stack)
+        walk(m.tree, [])
+    return findings

@@ -21,6 +21,7 @@ The optional `shared_exec` reuses argument/grad buffers across executors
 """
 from __future__ import annotations
 
+import logging
 import os
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -28,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import progcache as _progcache
 from . import random as _random
 from . import telemetry as _telemetry
 from .base import MXNetError
@@ -398,8 +400,47 @@ class Executor:
                 outs, new_params, new_states, aux_up = aot["jit"](
                     params, states, aux_values, rng, dv, *extra)
             else:
-                outs, new_params, new_states, aux_up = jitted(
-                    params, states, aux_values, rng, dv, *extra)
+                if _progcache.enabled() and "exec" not in aot:
+                    # Persistent program cache for the fused step: key by
+                    # the LOWERED text — update_fn is arbitrary Python, so
+                    # only lowering captures the actual program (a metadata
+                    # key could collide across optimizer rules). Donation
+                    # is part of the key and survives serialization. Any
+                    # failure pins the plain-jit path for this step fn.
+                    try:
+                        lowered = jitted.lower(params, states, aux_values,
+                                               rng, dv, *extra)
+                        key = _progcache.lowered_key(
+                            lowered.as_text(), donate=(0, 1),
+                            extra="train_step")
+                        exe = _progcache.load(key)
+                        if exe is None:
+                            exe = lowered.compile()
+                            _progcache.store(key, exe, note="train_step")
+                        aot["exec"] = exe
+                    except Exception:
+                        logging.getLogger("mxnet_tpu").warning(
+                            "progcache: train-step AOT path failed; "
+                            "using plain jit", exc_info=True)
+                        aot["exec"] = None
+                if aot.get("exec") is not None:
+                    try:
+                        outs, new_params, new_states, aux_up = aot["exec"](
+                            params, states, aux_values, rng, dv, *extra)
+                    except Exception:
+                        # a stale/incompatible loaded executable must never
+                        # fail the step: recompile via the jit path (inputs
+                        # are intact — argument processing precedes any
+                        # donation) and stop using the cached program
+                        logging.getLogger("mxnet_tpu").warning(
+                            "progcache: cached train step unusable; "
+                            "recompiling", exc_info=True)
+                        aot["exec"] = None
+                        outs, new_params, new_states, aux_up = jitted(
+                            params, states, aux_values, rng, dv, *extra)
+                else:
+                    outs, new_params, new_states, aux_up = jitted(
+                        params, states, aux_values, rng, dv, *extra)
             for n, v in aux_up.items():
                 self.aux_dict[n]._data = v
             self.outputs = [NDArray(o) for o in outs]

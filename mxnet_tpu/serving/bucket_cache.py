@@ -18,6 +18,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Sequence
 
+from .. import progcache as _progcache
 from .batcher import ServingError
 
 
@@ -43,7 +44,12 @@ class BucketCache:
         self._execs: Dict[int, object] = {}
         self.hits = 0
         self.misses = 0
+        # a miss builds the bucket's program one of two ways — a fresh XLA
+        # compile, or a disk load from the persistent progcache. The split
+        # is what lets the dryrun (and an operator) tell a warm restart
+        # from a compile storm.
         self.compiles = 0
+        self.disk_hits = 0
         # LRU bookkeeping for ladder swaps: logical tick per get(), so
         # set_ladder can retire the programs traffic stopped touching
         self._tick = 0
@@ -86,10 +92,19 @@ class BucketCache:
             shapes = {n: (bucket,) + s
                       for n, s in self._example_shapes.items()}
             exe = self._base.reshape(shapes, device=self._device)
-            self.compiles += 1
+            self._count_build(exe)
             self._execs[bucket] = exe
             self._last_used[bucket] = self._tick
             return exe
+
+    def _count_build(self, exe):
+        """A miss was just filled: either a fresh XLA compile or a disk
+        load from the persistent progcache (Predictor.progcache_source).
+        Callers hold ``_lock``."""
+        if getattr(exe, "progcache_source", "compile") == "disk":
+            self.disk_hits += 1
+        else:
+            self.compiles += 1
 
     def acquire(self, rows: int):
         """``(bucket, executor)`` for ``rows`` against the CURRENT ladder,
@@ -117,7 +132,7 @@ class BucketCache:
             shapes = {n: (bucket,) + s
                       for n, s in self._example_shapes.items()}
             exe = self._base.reshape(shapes, device=self._device)
-            self.compiles += 1
+            self._count_build(exe)
             self._execs[bucket] = exe
             self._last_used[bucket] = self._tick
             return bucket, exe
@@ -143,7 +158,7 @@ class BucketCache:
             cur = self._execs.get(bucket)
             if cur is not None:
                 return cur  # lost the race; the duplicate program is dropped
-            self.compiles += 1
+            self._count_build(exe)
             self._execs[bucket] = exe
             self._last_used[bucket] = self._tick
             return exe
@@ -178,7 +193,65 @@ class BucketCache:
             for b in retired:
                 del self._execs[b]
                 self._last_used.pop(b, None)
+        # version the persistent cache with the new ladder (outside _lock:
+        # progcache does its own locking and file I/O): the tuned ladder is
+        # saved so a restarted server adopts it immediately, and the kept
+        # buckets' entries get their LRU clocks bumped so the byte budget
+        # ages out the retired programs first.
+        self._progcache_sync(nb)
         return retired
+
+    # --- persistent-cache integration ------------------------------------
+    def _model_fp(self) -> Optional[str]:
+        """The base predictor's model fingerprint (None when the
+        persistent cache is disabled or the base can't be hashed)."""
+        if not _progcache.enabled():
+            return None
+        fp = getattr(self._base, "_progcache_model_fp", None)
+        if fp is None:
+            try:
+                fp = _progcache.model_fingerprint(
+                    self._base._symbol, self._base._arg_params,
+                    self._base._aux_params)
+                self._base._progcache_model_fp = fp
+            except Exception:
+                return None
+        return fp
+
+    def _bucket_key(self, fp: str, bucket: int) -> str:
+        shapes = {n: (bucket,) + s for n, s in self._example_shapes.items()}
+        device = (self._device if self._device is not None
+                  else self._base._device)
+        return _progcache.predictor_key(
+            fp, list(shapes), shapes, self._base._dtype, device)
+
+    def _progcache_sync(self, buckets: List[int]):
+        fp = self._model_fp()
+        if fp is None:
+            return
+        _progcache.save_ladder(fp, buckets)
+        for b in buckets:
+            _progcache.touch(self._bucket_key(fp, b))
+
+    def restore_ladder(self, budget: Optional[int] = None) -> bool:
+        """Adopt the ladder a previous process persisted for this model
+        (``progcache.save_ladder``), so a warm restart starts at the TUNED
+        ladder — and disk-loads exactly those programs — instead of
+        rediscovering it from live traffic. Returns True when a persisted
+        ladder was adopted. The persisted ladder must agree on max_batch
+        (the swap invariant) and fit ``budget``; otherwise it is ignored."""
+        fp = self._model_fp()
+        if fp is None:
+            return False
+        ladder = _progcache.load_ladder(fp)
+        if not ladder or ladder == self.buckets:
+            return False
+        if ladder[-1] != self.max_batch:
+            return False
+        if budget is not None and len(ladder) > budget:
+            return False
+        self.set_ladder(ladder, budget)
+        return True
 
     def warm(self):
         """Precompile every bucket (trade startup time for tail latency)."""
@@ -189,7 +262,13 @@ class BucketCache:
                 self.get(b)
 
     def stats(self) -> Dict[str, object]:
+        """``compiles`` counts FRESH XLA compiles only; ``disk_hits`` are
+        misses filled from the persistent progcache; ``cache_hits`` is the
+        in-memory hit count (alias of the historical ``hits`` key, kept
+        for compatibility)."""
         with self._lock:
-            return {"hits": self.hits, "misses": self.misses,
-                    "compiles": self.compiles, "buckets": list(self.buckets),
+            return {"hits": self.hits, "cache_hits": self.hits,
+                    "misses": self.misses, "compiles": self.compiles,
+                    "disk_hits": self.disk_hits,
+                    "buckets": list(self.buckets),
                     "compiled": sorted(self._execs)}

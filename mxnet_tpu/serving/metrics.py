@@ -168,7 +168,7 @@ class ServingMetrics:
         if self._cache_stats_fn:
             stats = self._cache_stats_fn()
             for k in ("compile_cache_hits", "compile_cache_misses",
-                      "compiles"):
+                      "compiles", "disk_hits"):
                 names.append(k)
                 values.append(stats.get(k.replace("compile_cache_", ""),
                                         stats.get(k, 0)))

@@ -33,6 +33,7 @@ import numpy as np
 
 from .. import engine
 from .. import predict as predict_mod
+from .. import progcache as _progcache
 from .. import telemetry
 from .batcher import BatchFormer, Request, ServingError
 from .bucket_cache import BucketCache
@@ -187,9 +188,22 @@ class InferenceServer:
         self._nbatch = 0
         self._thread: Optional[threading.Thread] = None
         self._started = False
-        if self.config.warm:
+        # With the persistent progcache enabled, a restarted server warms
+        # its whole ladder before accepting traffic — each bucket build is
+        # a disk load, not a compile, so this is seconds, not a compile
+        # storm. It first adopts the ladder a previous process tuned
+        # (progcache.save_ladder via set_ladder) so the restart lands on
+        # the tuned rungs, not the configured defaults. config.warm keeps
+        # its compile-eagerly meaning when the cache is off.
+        if self.config.warm or _progcache.enabled():
+            budget = (self.config.program_budget
+                      if self.config.adaptive else None)
             for rep in self._replicas:
+                if _progcache.enabled():
+                    rep.cache.restore_ladder(budget)
                 rep.cache.warm()
+            if _progcache.enabled():
+                self._ladder = tuple(self._replicas[0].cache.buckets)
 
     def _make_former(self) -> BatchFormer:
         former = BatchFormer(
@@ -204,7 +218,8 @@ class InferenceServer:
 
     # --- cache stats aggregated over replicas -----------------------------
     def _cache_stats(self) -> Dict:
-        agg = {"hits": 0, "misses": 0, "compiles": 0}
+        agg = {"hits": 0, "misses": 0, "compiles": 0, "disk_hits": 0,
+               "cache_hits": 0}
         for rep in self._replicas:
             s = rep.cache.stats()
             for k in agg:

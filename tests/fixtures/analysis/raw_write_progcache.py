@@ -1,0 +1,39 @@
+"""Fixture: progcache commit-discipline violations. Never imported —
+parsed only. Filename ends in ``progcache.py`` so the ``progcache_io``
+checker scopes to it.
+
+``bad_store`` commits an entry with a raw write-mode ``open()`` at the
+committed name (torn-write hazard); ``bad_append`` appends in place;
+``bad_dynamic_mode`` opens with a non-literal mode (assumed writable).
+``_atomic_write_bytes`` and ``good_load`` must NOT be flagged.
+"""
+import os
+
+
+def _atomic_write_bytes(path, data):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:          # inside the atomic helper: OK
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def bad_store(path, blob):
+    with open(path, "wb") as f:         # raw commit: flagged
+        f.write(blob)
+
+
+def bad_append(path, line):
+    with open(path, "a") as f:          # in-place append: flagged
+        f.write(line)
+
+
+def bad_dynamic_mode(path, blob, mode):
+    with open(path, mode) as f:         # non-literal mode: flagged
+        f.write(blob)
+
+
+def good_load(path):
+    with open(path, "rb") as f:         # read-only: not flagged
+        return f.read()

@@ -88,6 +88,24 @@ def test_capture_unstable_fixture_flags_mutated_var_container():
     assert not any(f.qualname.endswith(":stable_capture") for f in fs)
 
 
+def test_raw_write_progcache_fixture_flags_nonatomic_commits():
+    fs = analysis.run_analysis(fixture("raw_write_progcache.py"))
+    hits = [f for f in fs if f.rule == "raw-binary-commit"]
+    # the raw 'wb' commit, the in-place append, and the non-literal mode
+    flagged = {f.qualname.split(":")[-1] for f in hits}
+    assert flagged == {"bad_store", "bad_append", "bad_dynamic_mode"}
+    # the atomic helper itself and read-mode opens are clean
+    assert all("_atomic_write_bytes" not in f.qualname for f in hits)
+    assert all("good_load" not in f.qualname for f in hits)
+
+
+def test_progcache_io_scopes_to_progcache_modules_only():
+    # a raw write in a NON-progcache file is out of scope for this checker
+    fs = analysis.run_analysis(fixture("clean_locks.py"),
+                               checks=("progcache_io",))
+    assert fs == []
+
+
 def test_clean_fixture_has_no_findings():
     assert analysis.run_analysis(fixture("clean_locks.py")) == []
 
