@@ -935,6 +935,152 @@ def run_progcache_config():
     }
 
 
+def run_decode_config():
+    """Continuous-batching decode A/B (BENCH_MODEL=decode): the same
+    generate workload (BENCH_DECODE_STREAMS prompts x BENCH_DECODE_NEW
+    greedy tokens on a tiny transformer LM) through arm A = the
+    DecodeScheduler (iteration-level batching over slot-allocated KV
+    slabs, one fixed-shape decode program) and arm B = the naive serving
+    baseline (one sequence at a time, FULL-context re-prefill for every
+    token — what serving autoregression costs without a KV cache). Both
+    arms share compiled programs built before timing; each repeat runs
+    the arms BACK-TO-BACK and value = median of the per-repeat paired
+    tokens/sec ratios (checkpoint-bench idiom: paired ratios, not
+    min-vs-min, or CPU drift swings the number more than the gate).
+    ISSUE 9 gate: >= 2x, so vs_baseline = value / 2.0."""
+    import numpy as _np
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving.generate import (DecodeModel, DecodePrograms,
+                                            DecodeScheduler, DecodeSpec,
+                                            GenerateConfig)
+
+    v = int(os.environ.get("BENCH_DECODE_VOCAB", "64"))
+    d = int(os.environ.get("BENCH_DECODE_DIM", "32"))
+    n_layers = int(os.environ.get("BENCH_DECODE_LAYERS", "2"))
+    h, hkv = 4, 2
+    f = 2 * d
+    n_streams = int(os.environ.get("BENCH_DECODE_STREAMS", "8"))
+    prompt_len = int(os.environ.get("BENCH_DECODE_PROMPT", "6"))
+    new_tokens = int(os.environ.get("BENCH_DECODE_NEW", "24"))
+    slots = int(os.environ.get("BENCH_DECODE_SLOTS", "4"))
+    repeats = max(1, int(os.environ.get("BENCH_DECODE_REPEATS", "5")))
+    max_context = prompt_len + new_tokens + 2
+
+    rng = _np.random.RandomState(3)
+    dkv = d // h * hkv
+    params = {"embed_weight": (rng.randn(v, d) * 0.3).astype(_np.float32)}
+    for i in range(n_layers):
+        pre = "layer%d" % i
+        params[pre + "_ln1_gamma"] = _np.ones(d, _np.float32)
+        params[pre + "_ln1_beta"] = _np.zeros(d, _np.float32)
+        for nm, shape in (("q", (d, d)), ("k", (dkv, d)), ("v", (dkv, d)),
+                          ("o", (d, d))):
+            params["%s_%s_weight" % (pre, nm)] = (
+                rng.randn(*shape) * 0.2).astype(_np.float32)
+        params[pre + "_ln2_gamma"] = _np.ones(d, _np.float32)
+        params[pre + "_ln2_beta"] = _np.zeros(d, _np.float32)
+        params[pre + "_ffn1_weight"] = (rng.randn(f, d) * 0.2).astype(
+            _np.float32)
+        params[pre + "_ffn1_bias"] = _np.zeros(f, _np.float32)
+        params[pre + "_ffn2_weight"] = (rng.randn(d, f) * 0.2).astype(
+            _np.float32)
+        params[pre + "_ffn2_bias"] = _np.zeros(d, _np.float32)
+    params["lnf_gamma"] = _np.ones(d, _np.float32)
+    params["lnf_beta"] = _np.zeros(d, _np.float32)
+    params["pred_weight"] = (rng.randn(v, d) * 0.2).astype(_np.float32)
+    params["pred_bias"] = _np.zeros(v, _np.float32)
+
+    spec = DecodeSpec(num_heads=h, num_kv_heads=hkv)
+    model = DecodeModel.from_arg_params(params, spec)
+    prompts = [list(rng.randint(1, v, prompt_len)) for _ in range(n_streams)]
+
+    # arm A: scheduler built + programs compiled ONCE before timing
+    bucket = 1 << (prompt_len - 1).bit_length()
+    sched = DecodeScheduler(model, GenerateConfig(
+        num_heads=h, num_kv_heads=hkv, slots=slots,
+        max_context=max_context, prefill_buckets=(bucket,),
+        max_new_tokens=new_tokens, queue_depth=max(64, 2 * n_streams)))
+    sched.start()
+    occ_gauge = telemetry.registry.gauge("decode_batch_occupancy_pct")
+
+    def arm_continuous():
+        t0 = time.perf_counter()
+        streams = [sched.submit(p, max_new_tokens=new_tokens)
+                   for p in prompts]
+        max_occ = 0.0
+        while not all(s.done for s in streams):
+            max_occ = max(max_occ, float(occ_gauge.value))
+            time.sleep(0.001)
+        outs = [s.tokens(timeout=300.0) for s in streams]
+        dt = time.perf_counter() - t0
+        return sum(len(o) for o in outs) / dt, outs, max_occ
+
+    # arm B: naive full-context re-prefill per token, one stream at a
+    # time; its ladder (built before timing) covers the longest context
+    naive_buckets = tuple(sorted({bucket, 1 << (max_context - 1)
+                                  .bit_length(), max_context}))
+    naive = DecodePrograms(model, slots=1, capacity=max_context,
+                           prefill_buckets=naive_buckets)
+
+    def arm_naive():
+        t0 = time.perf_counter()
+        outs = []
+        for p in prompts:
+            ctx = list(p)
+            toks = []
+            for _ in range(new_tokens):
+                last, _k, _v = naive.prefill(ctx)
+                tok = int(_np.asarray(last).argmax())
+                toks.append(tok)
+                ctx.append(tok)
+            outs.append(toks)
+        dt = time.perf_counter() - t0
+        return sum(len(o) for o in outs) / dt, outs
+
+    # warmup both arms (compiles every program incl. naive's ladder)
+    arm_continuous()
+    arm_naive()
+
+    cont_tps, naive_tps, ratios = [], [], []
+    max_occ = 0.0
+    cont_outs = naive_outs = None
+    for _ in range(repeats):
+        tps_a, cont_outs, occ = arm_continuous()
+        tps_b, naive_outs = arm_naive()
+        cont_tps.append(tps_a)
+        naive_tps.append(tps_b)
+        ratios.append(tps_a / tps_b)
+        max_occ = max(max_occ, occ)
+    st = sched.stats()
+    sched.stop(drain=True)
+    # greedy decode against the cache must reproduce the re-prefill
+    # tokens exactly — the two arms ran the SAME workload or the ratio
+    # is meaningless
+    assert cont_outs == naive_outs, "arm outputs diverged"
+    # steady-state mean occupancy, derived from the scheduler's own
+    # counters: each decode step emits one token per active lane
+    decode_toks = n_streams * (new_tokens - 1) * (repeats + 1)
+    mean_occ = 100.0 * decode_toks / max(1, st["steps"] * slots)
+    speedup = statistics.median(ratios)
+    return {
+        "metric": "decode_continuous_batching",
+        "value": round(speedup, 3),
+        "unit": "tokens_per_sec_vs_reprefill_baseline",
+        # the >= 2x gate: >= 1.0 passes
+        "vs_baseline": round(speedup / 2.0, 3),
+        "cont_tokens_per_sec": round(statistics.median(cont_tps), 1),
+        "naive_tokens_per_sec": round(statistics.median(naive_tps), 1),
+        "max_occupancy_pct": round(max_occ, 1),
+        "mean_occupancy_pct": round(mean_occ, 1),
+        "streams": n_streams, "new_tokens": new_tokens, "slots": slots,
+        "prompt_len": prompt_len, "compiles": st["compiles"],
+        "decode_steps": st["steps"], "repeats": repeats,
+        "model": "LM V%d D%d L%dx%dh ctx%d" % (v, d, n_layers, h,
+                                               max_context),
+    }
+
+
 def main():
     try:
         _main()
@@ -956,6 +1102,9 @@ def _main():
         return
     if which == "progcache":
         _emit(run_progcache_config())
+        return
+    if which == "decode":
+        _emit(run_decode_config())
         return
     if os.environ.get("BENCH_LM_SWEEP"):
         # transformer (bs, seq) MFU table (docs/perf.md); one JSON line

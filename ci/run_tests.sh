@@ -42,8 +42,12 @@ echo "== stage 5: serving tests (dynamic batching + bucketed compile cache) =="
 # (batch former windows, deadlines, engine-dispatch pipelining), so it gets
 # its own stage where a hang or flake is attributable. Then the end-to-end
 # dry-run: concurrent clients -> occupancy/cache-hit assertions.
-JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
+JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py tests/test_serving_generate.py -q
 JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; g.dryrun_serving()"
+# Continuous-batching decode gate: staggered generate streams must emit
+# token streams identical to sequential generation, with fresh compiles
+# bounded by the fixed program set and a clean mid-stream drain.
+JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; g.dryrun_decode()"
 # Warm-restart gate (persistent progcache): a cold process populates the
 # cache and tunes its ladder, then a SECOND process over the same cache
 # dir must serve the same traffic with 0 fresh bucket compiles (ladder

@@ -73,6 +73,16 @@ LOCK_HIERARCHY: Dict[str, int] = {
     # staging pool buffer table: leaf — fill()/retain() touch only numpy
     # buffers under it.
     "serving.staging.StagingPool._lock": 100,
+    # decode scheduler condition: same stratum as the former — engine
+    # pushes/fences (rank 20) NEVER happen under it; stream/kv leaf locks
+    # may be taken under it.
+    "serving.generate.scheduler.DecodeScheduler._cond": 50,
+    # decode leaves: slot bookkeeping and per-stream token delivery only.
+    "serving.generate.kv_cache.KVCacheManager._lock": 100,
+    "serving.generate.stream.TokenStream._cond": 100,
+    # predictor run path: leaf — forward() holds it across the compiled
+    # call but never acquires anything ranked inside.
+    "predict.Predictor._run_lock": 100,
     # kvstore PS client: per-address data locks and the control-channel
     # lock are peers — liveness RPCs must work while data RPCs block.
     "kvstore_server.PSClient._locks[*]": 60,

@@ -140,10 +140,12 @@ def _multi_head_attention(attrs, query, key, value):
     return out.transpose(0, 2, 1, 3).reshape(b, tq, dm)
 
 
-def _grouped_attention(q, k, v, hkv, causal, scale=None):
+def _grouped_attention(q, k, v, hkv, causal, scale=None, mask=None):
     """GQA without materializing repeated kv: q (B, H, Tq, D) grouped as
     (B, Hkv, G, Tq, D) against k/v (B, Hkv, Tk, D) — kv streams once per
-    GROUP, which is the bandwidth/KV-cache saving GQA exists for."""
+    GROUP, which is the bandwidth/KV-cache saving GQA exists for.
+    ``mask``: optional (B, Tk) bool of valid key positions (broadcast over
+    heads/groups/query) — the KV-cache decode path's per-row length mask."""
     b, hh, tq, d = q.shape
     g = hh // hkv
     q5 = q.reshape(b, hkv, g, tq, d)
@@ -156,6 +158,33 @@ def _grouped_attention(q, k, v, hkv, causal, scale=None):
         idx_q = jnp.arange(tq)[:, None] + (tk - tq)
         cmask = idx_q >= jnp.arange(tk)[None, :]
         logits = jnp.where(cmask, logits, jnp.finfo(jnp.float32).min)
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, None, :], logits,
+                           jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bkgql,bkld->bkgqd", probs.astype(v.dtype), v)
     return out.reshape(b, hh, tq, d)
+
+
+def cached_attention(q, k_cache, v_cache, lengths):
+    """One autoregressive decode step against a padded KV cache.
+
+    ``q``: (B, H, 1, D) — the new token's query (already roped at its
+    absolute position). ``k_cache``/``v_cache``: (B, Hkv, C, D) slot
+    rows of a KV slab at fixed capacity C, holding each row's keys/values
+    at positions [0, lengths[i]] (the new token's k/v already written).
+    ``lengths``: (B,) int — the new token's position per row; key slots
+    beyond it are masked to exactly zero probability, so a row's output
+    is bitwise independent of whatever stale kv other slots or positions
+    hold — the invariant continuous batching rests on.
+
+    This is the fixed-shape twin of the prefill-side flash/GQA attention
+    (``_multi_head_attention``): same grouped-einsum math, f32 softmax,
+    Tq=1. The flash kernel's block contract needs Tq >= block, so the
+    decode step stays on the einsum path by construction.
+    """
+    hkv = k_cache.shape[1]
+    cap = k_cache.shape[2]
+    mask = jnp.arange(cap)[None, :] <= lengths[:, None]  # (B, C)
+    return _grouped_attention(q, k_cache, v_cache, hkv, causal=False,
+                              mask=mask)

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -108,6 +109,11 @@ class Predictor:
         self._inputs: Dict[str, Optional[NDArray]] = {
             n: None for n in self._input_shapes}
         self._outputs: List[NDArray] = []
+        # the run path (set_input/forward/get_output) mutates shared
+        # instance state; the decode scheduler thread and user threads may
+        # share one predictor, so serialize per instance (leaf lock, rank
+        # 100 in analysis/lockorder.py — nothing is acquired under it)
+        self._run_lock = threading.RLock()
         self._compile()
 
     def _compile(self):
@@ -174,26 +180,40 @@ class Predictor:
         if tuple(arr.shape) != self._input_shapes[name]:
             raise MXNetError("input %r shape %s != bound shape %s"
                              % (name, arr.shape, self._input_shapes[name]))
-        self._inputs[name] = arr
+        with self._run_lock:
+            self._inputs[name] = arr
 
     def forward(self, **inputs):
-        """MXPredForward; inputs may also be passed as kwargs."""
-        for k, v in inputs.items():
-            self.set_input(k, v)
-        vals = []
-        for n in self._input_names:
-            if self._inputs[n] is None:
-                raise MXNetError("input %r not set" % n)
-            vals.append(self._inputs[n]._data.astype(jnp.dtype(self._dtype)))
+        """MXPredForward; inputs may also be passed as kwargs.
+
+        Safe for concurrent callers: staged inputs are snapshotted and
+        outputs published under the instance run lock, so two threads'
+        calls can't clobber each other's state — each returns its own
+        result list. The compiled call itself runs OUTSIDE the lock
+        (XLA executables are safe to invoke concurrently), so callers
+        overlap on the device instead of serializing."""
+        with self._run_lock:
+            for k, v in inputs.items():
+                self.set_input(k, v)
+            vals = []
+            for n in self._input_names:
+                if self._inputs[n] is None:
+                    raise MXNetError("input %r not set" % n)
+                vals.append(
+                    self._inputs[n]._data.astype(jnp.dtype(self._dtype)))
         with self._device_scope():
-            outs = self._exec(*[jax.device_put(v, self._device) for v in vals]
-                              if self._device is not None else vals)
-        self._outputs = [NDArray(o) for o in outs]
-        return self._outputs
+            outs = self._exec(
+                *[jax.device_put(v, self._device) for v in vals]
+                if self._device is not None else vals)
+        result = [NDArray(o) for o in outs]
+        with self._run_lock:
+            self._outputs = result
+        return result
 
     def get_output(self, index: int) -> NDArray:
         """MXPredGetOutput."""
-        return self._outputs[index]
+        with self._run_lock:
+            return self._outputs[index]
 
     @property
     def output_names(self):
@@ -214,6 +234,7 @@ class Predictor:
         p._device = device if device is not None else self._device
         p._inputs = {n: None for n in p._input_shapes}
         p._outputs = []
+        p._run_lock = threading.RLock()  # __new__ bypasses __init__
         # params are shared by reference, so the model fingerprint (which
         # hashes their bytes) is shared too — a full-ladder warm() hashes
         # the weights once, not once per bucket

@@ -8,6 +8,13 @@ bucket, parameters shared) dispatched via the host engine
 (``InferenceServer``), with QPS/latency/occupancy/cache metrics
 (``ServingMetrics``). Failures are structured ``ServingError``s.
 
+The ``generate`` subpackage adds the autoregressive-decode workload on
+the same server: continuous batching with iteration-level scheduling
+(``DecodeScheduler``), slot-allocated KV slabs behind engine vars
+(``KVCacheManager``), and a bounded fixed-shape program set
+(``DecodePrograms``). Front door: ``InferenceServer.generate()`` /
+``submit_stream()`` when constructed with ``decode=GenerateConfig(...)``.
+
     from mxnet_tpu import serving
 
     srv = serving.create_server("ckpt/m", epoch=1,
@@ -21,6 +28,9 @@ bucket, parameters shared) dispatched via the host engine
 """
 from .batcher import BatchFormer, Request, ServingError
 from .bucket_cache import BucketCache
+from .generate import (DecodeModel, DecodePrograms, DecodeScheduler,
+                       DecodeSpec, GenerateConfig, KVCacheManager,
+                       TokenStream)
 from .metrics import ServingBatchEndParam, ServingMetrics
 from .server import InferenceServer, ServingConfig, create_server
 from .staging import StagingPool
@@ -30,4 +40,6 @@ __all__ = [
     "BatchFormer", "Request", "ServingError", "BucketCache",
     "ServingBatchEndParam", "ServingMetrics", "InferenceServer",
     "ServingConfig", "create_server", "StagingPool", "BucketTuner",
+    "DecodeModel", "DecodeSpec", "DecodePrograms", "KVCacheManager",
+    "DecodeScheduler", "GenerateConfig", "TokenStream",
 ]

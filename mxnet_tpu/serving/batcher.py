@@ -22,6 +22,9 @@ Failure is structured: every way a request can fail carries a
 - ``dispatch_error``     the compiled executor raised; the batch's requests
                          all carry the cause
 - ``wait_timeout``       ``Request.get(timeout)`` gave up waiting
+- ``cancelled``          the caller cancelled an in-flight generate stream
+                         (``TokenStream.cancel()``); its slot is freed at
+                         the next scheduler step
 """
 from __future__ import annotations
 

@@ -1,0 +1,20 @@
+"""Continuous batching for autoregressive decode (KV-cache serving).
+
+The LLM-inference workload on top of the serving stack: an Orca-style
+:class:`DecodeScheduler` re-forms the decode batch every step as
+sequences finish, :class:`KVCacheManager` owns slot-allocated KV slabs
+behind engine mutable vars, and :class:`DecodePrograms` bounds XLA
+compiles to (prefill ladder + decode step + admit) per replica via the
+persistent program cache. Front door: ``InferenceServer.generate()`` /
+``submit_stream()`` (serving/server.py).
+"""
+from .kv_cache import KVCacheManager
+from .model import DecodeModel, DecodeSpec
+from .programs import DecodePrograms
+from .scheduler import DecodeScheduler, GenerateConfig
+from .stream import TokenStream
+
+__all__ = [
+    "DecodeModel", "DecodeSpec", "DecodePrograms", "KVCacheManager",
+    "DecodeScheduler", "GenerateConfig", "TokenStream",
+]

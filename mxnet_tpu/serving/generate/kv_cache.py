@@ -1,0 +1,110 @@
+"""Slot-allocated KV-cache slabs, one pair per replica.
+
+``KVCacheManager`` owns the decode state the engine serializes: the two
+``(L, slots, Hkv, C, Dh)`` slabs live behind ONE engine variable per
+replica, and every program that touches them (admit, step) is pushed
+with ``mutable_vars=[var]`` — the engine's dependency ordering then
+serializes step N+1 after step N (and after any admits between them)
+with no lock of our own around device work.
+
+The *host-side* bookkeeping (which slot belongs to which sequence, each
+row's current length) is protected by ``_lock`` — a LEAF lock in the
+declared hierarchy (rank 100): nothing is ever acquired under it, and it
+is never held across an engine push or device call.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from ... import engine as _engine
+from ..batcher import ServingError
+from .programs import DecodePrograms
+
+
+class KVCacheManager:
+    """Slot allocator + slab holder for one replica's decode state."""
+
+    def __init__(self, programs: DecodePrograms, replica: int = 0):
+        self.programs = programs
+        self.replica = replica
+        self.slots = programs.slots
+        self.capacity = programs.capacity
+        self.var = _engine.new_variable()
+        _engine.track_inflight(self.var)
+        self.k_slab, self.v_slab = programs.fresh_slabs()
+        self._lock = threading.Lock()
+        # host mirrors: lengths[i] = tokens materialized in row i's kv
+        # (prompt + generated so far); owner[i] = opaque sequence tag
+        self._lengths = np.zeros(self.slots, np.int32)
+        self._owner: List[Optional[object]] = [None] * self.slots
+
+    # --- slot bookkeeping (host-only, leaf lock) -------------------------
+    def alloc(self, owner, prompt_len: int) -> Optional[int]:
+        """Claim a free slot for ``owner``; None if the batch is full."""
+        if prompt_len > self.capacity:
+            raise ServingError(
+                "prompt length %d exceeds kv capacity %d"
+                % (prompt_len, self.capacity), code="too_large")
+        with self._lock:
+            for i in range(self.slots):
+                if self._owner[i] is None:
+                    self._owner[i] = owner
+                    self._lengths[i] = prompt_len
+                    return i
+        return None
+
+    def free(self, slot: int):
+        with self._lock:
+            self._owner[slot] = None
+            self._lengths[slot] = 0
+
+    def advance(self, slot: int) -> int:
+        """Record one decoded token in ``slot``; returns the new length."""
+        with self._lock:
+            self._lengths[slot] += 1
+            return int(self._lengths[slot])
+
+    def length(self, slot: int) -> int:
+        with self._lock:
+            return int(self._lengths[slot])
+
+    def owner(self, slot: int):
+        with self._lock:
+            return self._owner[slot]
+
+    def active_slots(self) -> List[int]:
+        with self._lock:
+            return [i for i in range(self.slots)
+                    if self._owner[i] is not None]
+
+    def occupancy_pct(self) -> float:
+        with self._lock:
+            used = sum(1 for o in self._owner if o is not None)
+        return 100.0 * used / self.slots
+
+    def step_arrays(self):
+        """(lengths, mask) snapshots for the next decode step: inactive or
+        capacity-full rows get length 0 (their lane runs but the result is
+        discarded — fixed shape beats re-compiling per occupancy)."""
+        with self._lock:
+            lengths = self._lengths.copy()
+            mask = np.array([o is not None for o in self._owner], bool)
+        return lengths, mask
+
+    # --- slab plumbing (scheduler thread only) ---------------------------
+    def swap_slabs(self, k_slab, v_slab):
+        """Adopt the donated-output slabs a step/admit program returned."""
+        self.k_slab, self.v_slab = k_slab, v_slab
+
+    def reset(self):
+        """Fresh slabs + empty bookkeeping (server restart)."""
+        with self._lock:
+            self._lengths[:] = 0
+            self._owner = [None] * self.slots
+        self.k_slab, self.v_slab = self.programs.fresh_slabs()
+
+    def kv_bytes(self) -> int:
+        return self.programs.kv_bytes()

@@ -1,0 +1,289 @@
+"""Decode-side model: the KV-cache twin of ``models/transformer.py``.
+
+``DecodeModel`` holds the decoder-LM weights in a canonical stacked layout
+(per-layer arrays stacked on a leading L axis) plus the architecture facts
+the weights alone cannot carry (head counts), and builds the two pure
+functions the generate subsystem compiles:
+
+- ``prefill_fn(params, tokens (1, T), length (1,))`` — full causal forward
+  over a length-bucketed padded prompt, returning the next-token logits at
+  position ``length - 1`` and the prompt's K/V laid out at slab capacity
+  ``(L, 1, Hkv, C, Dh)``, ready to be slotted into a replica's KV slab.
+- ``decode_fn(params, k_slab, v_slab, lengths (B,), tokens (B,))`` — ONE
+  token for every slot at once: write each row's new k/v at position
+  ``lengths[i]``, attend over its own prefix only
+  (``ops.attention.cached_attention``), return (B, V) logits plus the
+  updated slabs (donated — the steady-state step allocates nothing new).
+
+The math mirrors ``models/transformer.py`` op for op (LayerNorm eps 1e-5,
+no-bias q/k/v/o, RoPE on split heads at absolute positions, exact-match
+gelu FFN, biased head) so a ``DecodeModel`` built from a Predictor's
+loaded checkpoint produces the same distribution the fixed-shape serving
+path scores — ``tests/test_serving_generate.py`` gates prefill logits
+against ``Predictor.forward`` and decode logits against re-prefill.
+
+Row independence is the correctness keystone: every per-position op is
+row-local and ``cached_attention`` masks by the row's own length, so a
+sequence's logits are bitwise identical regardless of which other
+sequences share the batch — the continuous-batching invariant.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.attention import cached_attention, rope
+from ..batcher import ServingError
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeSpec:
+    """Architecture facts not recoverable from weight shapes."""
+    num_heads: int
+    num_kv_heads: int = 0  # 0 = MHA (models/transformer.py convention)
+    rope_base: float = 10000.0
+
+    @property
+    def hkv(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+
+def _ln(x, g, b, eps=1e-5):
+    """ops.attention LayerNorm math (axis -1, eps 1e-5 — the op default
+    models/transformer.py binds)."""
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+class DecodeModel:
+    """Canonical stacked decoder-LM weights + derived dims.
+
+    ``params`` (all jnp arrays): embed (V, D); stacked per-layer
+    ln1_g/ln1_b/ln2_g/ln2_b (L, D), wq (L, D, D), wk/wv (L, Dkv, D),
+    wo (L, D, D), w1 (L, F, D), b1 (L, F), w2 (L, D, F), b2 (L, D);
+    lnf_g/lnf_b (D,), pred_w (V, D), pred_b (V,). FC weights keep the
+    (out, in) orientation of ops.nn.FullyConnected.
+    """
+
+    def __init__(self, params: Dict[str, jnp.ndarray], spec: DecodeSpec):
+        self.params = params
+        self.spec = spec
+        self.vocab, self.dm = params["embed"].shape
+        self.layers = params["wq"].shape[0]
+        self.dff = params["w1"].shape[1]
+        if self.dm % spec.num_heads:
+            raise ServingError("model_dim %d not divisible by num_heads %d"
+                               % (self.dm, spec.num_heads))
+        self.head_dim = self.dm // spec.num_heads
+        want_dkv = self.head_dim * spec.hkv
+        if params["wk"].shape[1] != want_dkv:
+            raise ServingError(
+                "k projection rows %d != num_kv_heads*head_dim %d — wrong "
+                "num_heads/num_kv_heads for these weights?"
+                % (params["wk"].shape[1], want_dkv))
+
+    # --- construction ----------------------------------------------------
+    @classmethod
+    def from_arg_params(cls, arg_params: Dict, spec: DecodeSpec,
+                        dtype="float32") -> "DecodeModel":
+        """Build from ``models/transformer.py`` checkpoint naming (the
+        dict a Predictor loads: embed_weight, layer%d_q_weight, ...).
+        Accepts NDArray or numpy values."""
+        def get(name):
+            if name not in arg_params:
+                raise ServingError(
+                    "decode model: checkpoint lacks %r — is this a "
+                    "models/transformer.py decoder LM?" % name)
+            v = arg_params[name]
+            v = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+            return jnp.asarray(v.astype(dtype))
+
+        n_layers = 0
+        while ("layer%d_q_weight" % n_layers) in arg_params:
+            n_layers += 1
+        if n_layers == 0:
+            raise ServingError("decode model: no layer0_q_weight in params")
+        stacked: Dict[str, list] = {k: [] for k in (
+            "ln1_g", "ln1_b", "wq", "wk", "wv", "wo", "ln2_g", "ln2_b",
+            "w1", "b1", "w2", "b2")}
+        for i in range(n_layers):
+            p = "layer%d" % i
+            stacked["ln1_g"].append(get(p + "_ln1_gamma"))
+            stacked["ln1_b"].append(get(p + "_ln1_beta"))
+            stacked["wq"].append(get(p + "_q_weight"))
+            stacked["wk"].append(get(p + "_k_weight"))
+            stacked["wv"].append(get(p + "_v_weight"))
+            stacked["wo"].append(get(p + "_o_weight"))
+            stacked["ln2_g"].append(get(p + "_ln2_gamma"))
+            stacked["ln2_b"].append(get(p + "_ln2_beta"))
+            stacked["w1"].append(get(p + "_ffn1_weight"))
+            stacked["b1"].append(get(p + "_ffn1_bias"))
+            stacked["w2"].append(get(p + "_ffn2_weight"))
+            stacked["b2"].append(get(p + "_ffn2_bias"))
+        params = {k: jnp.stack(v) for k, v in stacked.items()}
+        params["embed"] = get("embed_weight")
+        params["lnf_g"] = get("lnf_gamma")
+        params["lnf_b"] = get("lnf_beta")
+        params["pred_w"] = get("pred_weight")
+        params["pred_b"] = get("pred_bias")
+        return cls(params, spec)
+
+    def kv_slab_shape(self, slots: int, capacity: int) -> tuple:
+        """(L, slots, Hkv, C, Dh) — one of the two per-replica slabs."""
+        return (self.layers, slots, self.spec.hkv, capacity, self.head_dim)
+
+    def fingerprint_items(self):
+        """(name, array) pairs in stable order, for the progcache model
+        fingerprint (weights are program ARGS here, but the fingerprint
+        still keys persisted metadata like ladders)."""
+        return [(k, self.params[k]) for k in sorted(self.params)]
+
+    # --- the two programs -------------------------------------------------
+    def _project(self, h, l, b, t):
+        """q/k/v projections of (b, t, D) -> split-head (b, {H|Hkv}, t, Dh),
+        roped later (rope needs absolute positions)."""
+        p, s = self.params, self.spec
+        q = h @ p["wq"][l].T
+        k = h @ p["wk"][l].T
+        v = h @ p["wv"][l].T
+        q = q.reshape(b, t, s.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(b, t, s.hkv, self.head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(b, t, s.hkv, self.head_dim).transpose(0, 2, 1, 3)
+        return q, k, v
+
+    def _mlp(self, x, l):
+        p = self.params
+        h = _ln(x, p["ln2_g"][l], p["ln2_b"][l])
+        h = jax.nn.gelu(h @ p["w1"][l].T + p["b1"][l])
+        return x + (h @ p["w2"][l].T + p["b2"][l])
+
+    def _head(self, x):
+        p = self.params
+        x = _ln(x, p["lnf_g"], p["lnf_b"])
+        return x @ p["pred_w"].T + p["pred_b"]
+
+    def build_prefill(self, bucket: int, capacity: int):
+        """Pure fn (params, tokens (1, T=bucket) i32, length (1,) i32) ->
+        (logits (1, V) f32, k (L, 1, Hkv, C, Dh), v (...)). Padded
+        positions >= length produce garbage kv that decode never reads
+        (masked by length); the causal mask keeps them out of the
+        returned last-real-position logits."""
+        if bucket > capacity:
+            raise ServingError("prefill bucket %d exceeds kv capacity %d"
+                               % (bucket, capacity))
+        spec = self.spec
+
+        def prefill(params, tokens, length):
+            self_p = DecodeModel.__new__(DecodeModel)
+            self_p.params = params
+            self_p.spec = spec
+            self_p.vocab, self_p.dm = params["embed"].shape
+            self_p.layers = params["wq"].shape[0]
+            self_p.head_dim = self_p.dm // spec.num_heads
+            x = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
+            ks, vs = [], []
+            for l in range(self_p.layers):
+                h = _ln(x, params["ln1_g"][l], params["ln1_b"][l])
+                q, k, v = self_p._project(h, l, 1, bucket)
+                q, k = rope(q, base=spec.rope_base), \
+                    rope(k, base=spec.rope_base)
+                # same fusion seam as the serving forward path: the flash
+                # kernel owns the on-TPU/shape gate and falls back to the
+                # grouped einsum / reference math off it
+                from ...ops.pallas import flash_attention as _fa
+                att = _fa.flash_attention(q, k, v, causal=True)
+                att = att.transpose(0, 2, 1, 3).reshape(1, bucket, self_p.dm)
+                x = x + att @ params["wo"][l].T
+                x = self_p._mlp(x, l)
+                ks.append(k)
+                vs.append(v)
+            logits = self_p._head(x)  # (1, T, V)
+            last = jnp.take_along_axis(
+                logits, (length - 1).astype(jnp.int32)[:, None, None], axis=1
+            )[:, 0, :]
+            pad = ((0, 0), (0, 0), (0, 0), (0, capacity - bucket), (0, 0))
+            k_out = jnp.pad(jnp.stack(ks), pad)   # (L, 1, Hkv, C, Dh)
+            v_out = jnp.pad(jnp.stack(vs), pad)
+            return last, k_out, v_out
+
+        return prefill
+
+    def build_decode(self, slots: int, capacity: int):
+        """Pure fn (params, k_slab, v_slab, lengths (B,) i32, tokens (B,)
+        i32) -> (logits (B, V), k_slab, v_slab). Slabs are meant to be
+        donated by the compiler wrapper: steady state rewrites C-slices in
+        place and allocates only the (B, V) logits. Inactive slots run
+        with lengths pinned to 0 — wasted lanes, never wrong lanes."""
+        spec = self.spec
+
+        def decode(params, k_slab, v_slab, lengths, tokens):
+            dm = params["embed"].shape[1]
+            n_layers = params["wq"].shape[0]
+            head_dim = dm // spec.num_heads
+            lengths = lengths.astype(jnp.int32)
+            x = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
+            # rope positions: the new token sits at index `length`
+            pos = lengths.reshape(slots, 1, 1)
+            for l in range(n_layers):
+                h = _ln(x, params["ln1_g"][l], params["ln1_b"][l])
+                q = (h @ params["wq"][l].T).reshape(
+                    slots, spec.num_heads, 1, head_dim)
+                k_t = (h @ params["wk"][l].T).reshape(
+                    slots, spec.hkv, 1, head_dim)
+                v_t = (h @ params["wv"][l].T).reshape(
+                    slots, spec.hkv, 1, head_dim)
+                q = rope(q, positions=pos, base=spec.rope_base)
+                k_t = rope(k_t, positions=pos, base=spec.rope_base)
+
+                def write(cache, new, p):
+                    # cache (Hkv, C, Dh), new (Hkv, 1, Dh): row's k/v lands
+                    # at its own position p = lengths[i]
+                    return jax.lax.dynamic_update_slice(cache, new, (0, p, 0))
+
+                k_l = jax.vmap(write)(k_slab[l], k_t, lengths)
+                v_l = jax.vmap(write)(v_slab[l], v_t, lengths)
+                k_slab = k_slab.at[l].set(k_l)
+                v_slab = v_slab.at[l].set(v_l)
+                att = cached_attention(q, k_l, v_l, lengths)
+                att = att.transpose(0, 2, 1, 3).reshape(slots, dm)
+                x = x + att @ params["wo"][l].T
+                h2 = _ln(x, params["ln2_g"][l], params["ln2_b"][l])
+                h2 = jax.nn.gelu(h2 @ params["w1"][l].T + params["b1"][l])
+                x = x + (h2 @ params["w2"][l].T + params["b2"][l])
+            logits = _ln(x, params["lnf_g"], params["lnf_b"]) \
+                @ params["pred_w"].T + params["pred_b"]
+            return logits, k_slab, v_slab
+
+        return decode
+
+    def build_admit(self, slots: int, capacity: int):
+        """Pure fn (k_slab, v_slab, k_new (L,1,Hkv,C,Dh), v_new, slot i32)
+        -> updated slabs (donated): slot a freshly prefilled sequence's kv
+        into its allocated row."""
+        def admit(k_slab, v_slab, k_new, v_new, slot):
+            slot = slot.astype(jnp.int32)
+            z = jnp.int32(0)
+            return (jax.lax.dynamic_update_slice(k_slab, k_new,
+                                                 (z, slot, z, z, z)),
+                    jax.lax.dynamic_update_slice(v_slab, v_new,
+                                                 (z, slot, z, z, z)))
+
+        return admit
+
+
+def infer_spec_dims(arg_params: Dict) -> Dict[str, int]:
+    """Dims recoverable from a models/transformer.py checkpoint (vocab,
+    model_dim, ffn_dim, layers) — head counts must come from DecodeSpec."""
+    embed = arg_params["embed_weight"]
+    shape = embed.shape
+    n_layers = 0
+    while ("layer%d_q_weight" % n_layers) in arg_params:
+        n_layers += 1
+    ffn1 = arg_params["layer0_ffn1_weight"]
+    return {"vocab": int(shape[0]), "model_dim": int(shape[1]),
+            "layers": n_layers, "ffn_dim": int(ffn1.shape[0])}

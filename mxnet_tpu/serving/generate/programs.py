@@ -1,0 +1,207 @@
+"""Fixed-shape program set for continuous-batching decode.
+
+The whole subsystem compiles exactly three kinds of XLA program per
+(replica, slot-capacity) configuration, and nothing else, no matter how
+requests arrive:
+
+- one **prefill** program per prompt-length bucket in the ladder
+  (batch 1, padded to the bucket, emits slab-capacity K/V),
+- ONE **decode** program (batch = all slots, one token each, slabs
+  donated — the steady-state step, compiled once, replayed forever),
+- ONE **admit** program (dynamic-slice a prefilled sequence's K/V into
+  its allocated slot row, slabs donated).
+
+That bound is what `dryrun_decode` asserts: fresh compiles ≤ ladder size
++ 2 per replica. Every program goes through ``progcache`` keyed by its
+LOWERED StableHLO text (the executor's train-step idiom — weights are
+program *arguments* here, so the key is weight-independent and a warm
+restart disk-loads the whole set), with the same stale-executable
+fallback: a cached program that fails to run is dropped and the plain
+``jax.jit`` path recompiles, never failing the request.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import progcache as _progcache
+from ..batcher import ServingError
+from .model import DecodeModel
+
+log = logging.getLogger("mxnet_tpu")
+
+
+def _avals(tree):
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+
+
+class _Compiled:
+    """One AOT program: progcache-persisted executable with jit fallback.
+
+    ``counters`` is the owning DecodePrograms — fresh XLA compiles and
+    progcache disk hits are tallied there so CI can gate the bound.
+    """
+
+    def __init__(self, fn, donate: Sequence[int], note: str, avals,
+                 counters: "DecodePrograms"):
+        self._jit = jax.jit(fn, donate_argnums=tuple(donate))
+        self._exec = None
+        self.source = "jit"
+        try:
+            lowered = self._jit.lower(*avals)
+            key = None
+            if _progcache.enabled():
+                key = _progcache.lowered_key(
+                    lowered.as_text(), donate=tuple(donate), extra=note)
+                exe = _progcache.load(key)
+                if exe is not None:
+                    self._exec, self.source = exe, "disk"
+                    counters.disk_hits += 1
+                    return
+            self._exec = lowered.compile()
+            self.source = "compile"
+            counters.compiles += 1
+            if key is not None:
+                _progcache.store(key, self._exec, note=note)
+        except Exception:
+            # anything going sideways in lowering/AOT pins the plain-jit
+            # path; its first call is still one fresh compile
+            log.warning("generate: AOT path failed for %s; using plain jit",
+                        note, exc_info=True)
+            self._exec = None
+            counters.compiles += 1
+
+    def __call__(self, *args):
+        if self._exec is not None:
+            try:
+                return self._exec(*args)
+            except Exception:
+                # stale/incompatible disk-loaded executable: drop it and
+                # recompile via jit (args are intact — argument processing
+                # precedes donation)
+                log.warning("generate: cached program unusable; recompiling",
+                            exc_info=True)
+                self._exec = None
+        return self._jit(*args)
+
+
+class DecodePrograms:
+    """The compiled program set for one model at one slot/capacity config.
+
+    Thread-safety: construction and ``prefill``'s lazy per-bucket build
+    happen on the scheduler thread only; the compiled callables themselves
+    are pure and safe to invoke from engine worker threads.
+    """
+
+    def __init__(self, model: DecodeModel, slots: int, capacity: int,
+                 prefill_buckets: Sequence[int]):
+        buckets = sorted({int(b) for b in prefill_buckets})
+        if not buckets:
+            raise ServingError("decode: empty prefill bucket ladder")
+        if buckets[-1] > capacity:
+            raise ServingError(
+                "decode: prefill bucket %d exceeds kv capacity %d"
+                % (buckets[-1], capacity))
+        self.model = model
+        self.slots = int(slots)
+        self.capacity = int(capacity)
+        self.buckets: List[int] = buckets
+        self.compiles = 0    # fresh XLA compiles (the CI-gated bound)
+        self.disk_hits = 0   # progcache warm loads
+        self._params_avals = _avals(model.params)
+        self._prefill: Dict[int, _Compiled] = {}
+        slab = jax.ShapeDtypeStruct(
+            model.kv_slab_shape(self.slots, self.capacity), jnp.float32)
+        ints = lambda n: jax.ShapeDtypeStruct((n,), jnp.int32)  # noqa: E731
+        self._decode = _Compiled(
+            model.build_decode(self.slots, self.capacity), donate=(1, 2),
+            note="decode_step", avals=(self._params_avals, slab, slab,
+                                       ints(self.slots), ints(self.slots)),
+            counters=self)
+        kv_new = jax.ShapeDtypeStruct(
+            model.kv_slab_shape(1, self.capacity), jnp.float32)
+        self._admit = _Compiled(
+            model.build_admit(self.slots, self.capacity), donate=(0, 1),
+            note="decode_admit", avals=(slab, slab, kv_new, kv_new,
+                                        jax.ShapeDtypeStruct((), jnp.int32)),
+            counters=self)
+
+    # --- shapes -----------------------------------------------------------
+    def fresh_slabs(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        shape = self.model.kv_slab_shape(self.slots, self.capacity)
+        return jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
+
+    def kv_bytes(self) -> int:
+        shape = self.model.kv_slab_shape(self.slots, self.capacity)
+        return 2 * int(np.prod(shape)) * 4  # k + v slabs, f32
+
+    def bucket_for(self, prompt_len: int) -> Optional[int]:
+        """Smallest ladder bucket holding the prompt, or None (too long)."""
+        for b in self.buckets:
+            if prompt_len <= b:
+                return b
+        return None
+
+    def warm(self):
+        """Build every prefill bucket up front (server start option)."""
+        for b in self.buckets:
+            self._prefill_for(b)
+
+    def ensure_prefill(self, prompt_len: int):
+        """Build (or no-op) the bucket program for ``prompt_len`` on the
+        CALLING thread — the scheduler uses this so engine workers only
+        ever invoke already-built programs."""
+        bucket = self.bucket_for(prompt_len)
+        if bucket is not None:
+            self._prefill_for(bucket)
+
+    def _prefill_for(self, bucket: int) -> _Compiled:
+        prog = self._prefill.get(bucket)
+        if prog is None:
+            prog = _Compiled(
+                self.model.build_prefill(bucket, self.capacity), donate=(),
+                note="decode_prefill_%d" % bucket,
+                avals=(self._params_avals,
+                       jax.ShapeDtypeStruct((1, bucket), jnp.int32),
+                       jax.ShapeDtypeStruct((1,), jnp.int32)),
+                counters=self)
+            self._prefill[bucket] = prog
+        return prog
+
+    # --- execution --------------------------------------------------------
+    def prefill(self, token_ids: Sequence[int]):
+        """Run one prompt through its bucket's prefill program.
+
+        Returns (last_logits (V,) ndarray-backed jax array,
+        k_new, v_new (L, 1, Hkv, C, Dh)).
+        """
+        n = len(token_ids)
+        bucket = self.bucket_for(n)
+        if bucket is None:
+            raise ServingError(
+                "prompt length %d exceeds largest prefill bucket %d"
+                % (n, self.buckets[-1]), code="too_large")
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :n] = np.asarray(token_ids, np.int32)
+        last, k_new, v_new = self._prefill_for(bucket)(
+            self.model.params, jnp.asarray(toks),
+            jnp.asarray([n], jnp.int32))
+        return last[0], k_new, v_new
+
+    def decode(self, k_slab, v_slab, lengths, tokens):
+        """One step for every slot. ``lengths``/``tokens``: (slots,) i32
+        (inactive slots: length 0, token 0 — lanes wasted, never wrong).
+        Donates the slabs; use the returned ones."""
+        return self._decode(self.model.params, k_slab, v_slab,
+                            jnp.asarray(lengths, jnp.int32),
+                            jnp.asarray(tokens, jnp.int32))
+
+    def admit(self, k_slab, v_slab, k_new, v_new, slot: int):
+        """Slot a prefilled sequence's K/V into the slabs (donates slabs)."""
+        return self._admit(k_slab, v_slab, k_new, v_new,
+                           jnp.asarray(slot, jnp.int32))

@@ -1,0 +1,435 @@
+"""Orca-style iteration-level scheduler for continuous-batching decode.
+
+One scheduler thread drives every replica: each loop iteration it
+(1) expires/admits waiting prefills into freed slots, (2) pushes ONE
+fixed-shape decode step per occupied replica onto the engine
+(``mutable_vars=[kv var]`` — the engine's dependency ordering serializes
+step N+1 after step N and after any admits between them), (3) fences,
+samples greedily on the host, streams tokens out, and retires finished
+sequences — so the batch is re-formed **every step** as sequences finish
+and new ones join mid-flight.
+
+Compile discipline: all device work goes through the fixed
+``DecodePrograms`` set (prefill ladder + one decode step + one admit per
+replica), so steady state compiles nothing regardless of traffic shape.
+The decode-step push is optionally routed through an
+``engine.CapturedSequence`` per replica (``MXNET_ENGINE_CAPTURE`` /
+``GenerateConfig.capture``): its signature is occupancy-independent, so
+the steady-state step replays with near-zero host dispatch overhead.
+
+Lock discipline (declared in ``analysis/lockorder.py``):
+``DecodeScheduler._cond`` has rank 50 — engine pushes and fences
+(``engine._engine_lock``, rank 20) NEVER happen while it is held;
+``TokenStream._cond`` and ``KVCacheManager._lock`` are leaves (rank 100)
+and may be taken under it.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import engine as _engine
+from ... import telemetry as _telemetry
+from ..batcher import ServingError
+from .kv_cache import KVCacheManager
+from .model import DecodeModel
+from .programs import DecodePrograms
+from .stream import TokenStream
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_buckets():
+    raw = os.environ.get("MXNET_DECODE_PREFILL_BUCKETS", "8,16,32")
+    try:
+        return tuple(sorted({int(b) for b in raw.split(",") if b.strip()}))
+    except ValueError:
+        return (8, 16, 32)
+
+
+def _env_eos():
+    raw = os.environ.get("MXNET_DECODE_EOS", "")
+    try:
+        return int(raw) if raw.strip() else None
+    except ValueError:
+        return None
+
+
+@dataclasses.dataclass
+class GenerateConfig:
+    """Decode-side knobs; every default reads its ``MXNET_DECODE_*`` env
+    var at construction time (docs/env_var.md has the table). Head counts
+    have no env default — they are architecture facts of the checkpoint."""
+    num_heads: int
+    num_kv_heads: int = 0
+    slots: int = dataclasses.field(
+        default_factory=lambda: _env_int("MXNET_DECODE_SLOTS", 4))
+    max_context: int = dataclasses.field(
+        default_factory=lambda: _env_int("MXNET_DECODE_MAX_CONTEXT", 64))
+    prefill_buckets: Tuple[int, ...] = dataclasses.field(
+        default_factory=_env_buckets)
+    max_new_tokens: int = dataclasses.field(
+        default_factory=lambda: _env_int("MXNET_DECODE_MAX_NEW_TOKENS", 32))
+    queue_depth: int = dataclasses.field(
+        default_factory=lambda: _env_int("MXNET_DECODE_QUEUE_DEPTH", 64))
+    eos_id: Optional[int] = dataclasses.field(default_factory=_env_eos)
+    capture: bool = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "MXNET_DECODE_CAPTURE", "0").lower()
+        not in ("0", "", "false", "off"))
+    rope_base: float = 10000.0
+
+
+class _Active:
+    """One sequence occupying a slot."""
+    __slots__ = ("stream", "replica", "slot", "last_token", "generated")
+
+    def __init__(self, stream, replica, slot, last_token, generated):
+        self.stream = stream
+        self.replica = replica
+        self.slot = slot
+        self.last_token = last_token
+        self.generated = generated
+
+
+class DecodeScheduler:
+    """Continuous-batching decode over one model across N replica slabs."""
+
+    def __init__(self, model: DecodeModel, config: GenerateConfig,
+                 replicas: int = 1):
+        self.config = config
+        self.model = model
+        self.programs = DecodePrograms(model, config.slots,
+                                       config.max_context,
+                                       config.prefill_buckets)
+        self.replicas = int(replicas)
+        self.caches: List[KVCacheManager] = []
+        self._cond = threading.Condition()       # rank 50
+        self._queue: deque = deque()             # (stream, prompt tokens)
+        self._active: Dict[Tuple[int, int], _Active] = {}
+        self._state = "stopped"                  # running|draining|stopped
+        self._thread: Optional[threading.Thread] = None
+        self._captures: List[Optional[_engine.CapturedSequence]] = []
+        self.steps = 0
+        reg = _telemetry.registry
+        self._m_tokens = reg.counter(
+            "decode_tokens_total", help="tokens emitted by decode streams")
+        # explicit .set() (not fn=) — get_or_create would pin a stale
+        # callback to a dead scheduler across server restarts
+        self._m_occ = reg.gauge(
+            "decode_batch_occupancy_pct",
+            help="decode slots occupied, % (mean over replicas)")
+        self._m_kv = reg.gauge(
+            "kv_bytes", help="bytes held in decode KV slabs")
+
+    # --- lifecycle --------------------------------------------------------
+    def start(self):
+        with self._cond:
+            if self._state != "stopped":
+                return
+            self._state = "running"
+        self.caches = [KVCacheManager(self.programs, i)
+                       for i in range(self.replicas)]
+        use_capture = self.config.capture or _engine.capture_enabled()
+        self._captures = [
+            _engine.CapturedSequence(name="decode_step_r%d" % i)
+            if use_capture else None for i in range(self.replicas)]
+        self._m_kv.set(sum(c.kv_bytes() for c in self.caches))
+        self._thread = threading.Thread(target=self._loop,
+                                        name="decode-scheduler", daemon=True)
+        self._thread.start()
+
+    def stop(self, drain: bool = False, deadline_ms: Optional[float] = None):
+        """Stop the scheduler. ``drain=True`` finishes in-flight and queued
+        streams first (refusing new submits, code ``shutting_down``);
+        ``drain=False`` fails everything immediately (code ``shutdown``)."""
+        with self._cond:
+            if self._state == "stopped" and self._thread is None:
+                return
+            self._state = "draining" if drain else "stopped"
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None:
+            timeout = None if deadline_ms is None else deadline_ms / 1000.0
+            t.join(timeout)
+            if t.is_alive():
+                # drain deadline passed: force the loop out
+                with self._cond:
+                    self._state = "stopped"
+                    self._cond.notify_all()
+                t.join()
+        self._thread = None
+        code = "shutting_down" if drain else "shutdown"
+        leftovers: List[TokenStream] = []
+        with self._cond:
+            self._state = "stopped"
+            while self._queue:
+                leftovers.append(self._queue.popleft()[0])
+            actives, self._active = list(self._active.values()), {}
+        for a in actives:
+            self.caches[a.replica].free(a.slot)
+            leftovers.append(a.stream)
+        for s in leftovers:
+            s._fail(ServingError("decode scheduler stopped", code=code))
+        for cs in self._captures:
+            if cs is not None:
+                cs.invalidate("scheduler stopped")
+        if self.caches:
+            _engine.fence([c.var for c in self.caches]).wait()
+            for c in self.caches:
+                _engine.delete_variable(c.var)
+        self.caches = []
+        self._m_occ.set(0.0)
+
+    # --- submission -------------------------------------------------------
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: Optional[int] = None,
+               timeout_ms: Optional[float] = None) -> TokenStream:
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ServingError("empty prompt", code="too_large")
+        if self.programs.bucket_for(len(prompt)) is None:
+            raise ServingError(
+                "prompt length %d exceeds largest prefill bucket %d"
+                % (len(prompt), self.programs.buckets[-1]), code="too_large")
+        if len(prompt) >= self.programs.capacity:
+            raise ServingError(
+                "prompt length %d leaves no kv capacity (max_context %d)"
+                % (len(prompt), self.programs.capacity), code="too_large")
+        max_new = int(max_new_tokens or self.config.max_new_tokens)
+        if max_new < 1:
+            raise ServingError("max_new_tokens must be >= 1",
+                               code="too_large")
+        deadline = None if timeout_ms is None \
+            else time.monotonic() + timeout_ms / 1000.0
+        stream = TokenStream(len(prompt), max_new, deadline)
+        with self._cond:
+            if self._state == "draining":
+                raise ServingError("server is draining",
+                                   code="shutting_down")
+            if self._state != "running":
+                raise ServingError("decode scheduler not running",
+                                   code="shutdown")
+            if len(self._queue) >= self.config.queue_depth:
+                raise ServingError("decode queue full", code="queue_full")
+            self._queue.append((stream, prompt))
+            self._cond.notify_all()
+        return stream
+
+    # --- scheduler loop ---------------------------------------------------
+    def _loop(self):
+        while True:
+            with self._cond:
+                while (self._state == "running" and not self._queue
+                       and not self._active):
+                    self._cond.wait(0.1)
+                if self._state == "stopped":
+                    return
+                if (self._state == "draining" and not self._queue
+                        and not self._active):
+                    return
+            self._expire_and_cancel()
+            self._admit_waiting()
+            self._step_all()
+            occ = [c.occupancy_pct() for c in self.caches]
+            self._m_occ.set(sum(occ) / max(1, len(occ)))
+
+    def _expire_and_cancel(self):
+        now = time.monotonic()
+        expired: List[TokenStream] = []
+        cancelled: List[TokenStream] = []
+        with self._cond:
+            keep: deque = deque()
+            for item in self._queue:
+                s = item[0]
+                if s.cancelled:
+                    cancelled.append(s)
+                elif s.deadline is not None and now > s.deadline:
+                    expired.append(s)
+                else:
+                    keep.append(item)
+            self._queue = keep
+        for s in cancelled:
+            s._finish("cancelled")
+        for s in expired:
+            s._fail(ServingError("expired before a decode slot freed",
+                                 code="deadline_exceeded"))
+        # active sequences: retire cancelled/expired before the next step
+        for key, a in list(self._active.items()):
+            if a.stream.cancelled:
+                self._retire(a, reason="cancelled")
+            elif a.stream.deadline is not None and now > a.stream.deadline:
+                self._retire(a, error=ServingError(
+                    "deadline exceeded mid-stream",
+                    code="deadline_exceeded"))
+
+    def _retire(self, a: _Active, reason: Optional[str] = None,
+                error: Optional[ServingError] = None):
+        self.caches[a.replica].free(a.slot)
+        with self._cond:
+            self._active.pop((a.replica, a.slot), None)
+        if error is not None:
+            a.stream._fail(error)
+        else:
+            a.stream._finish(reason or "eos")
+
+    def _pick_replica(self) -> Optional[int]:
+        best, best_free = None, 0
+        for i, c in enumerate(self.caches):
+            free = c.slots - len(c.active_slots())
+            if free > best_free:
+                best, best_free = i, free
+        return best
+
+    def _admit_waiting(self):
+        """Prefill waiting prompts into free slots. Each admission is one
+        engine op on the target replica's kv var (prefill → slot insert →
+        first-token sample), fenced as a group so fresh sequences join the
+        very next decode step."""
+        admitted = []         # (active, holder)
+        touched = []
+        while True:
+            rep = self._pick_replica()
+            if rep is None:
+                break
+            with self._cond:
+                if not self._queue:
+                    break
+                stream, prompt = self._queue.popleft()
+            cache = self.caches[rep]
+            slot = cache.alloc(stream, len(prompt))
+            if slot is None:      # raced nothing — replica filled; requeue
+                with self._cond:
+                    self._queue.appendleft((stream, prompt))
+                break
+            # build the bucket's prefill program here (scheduler thread)
+            # so the engine op never mutates the program dict — two
+            # replicas' workers could otherwise race the lazy build
+            self.programs.ensure_prefill(len(prompt))
+            holder: Dict[str, object] = {}
+            admitted.append((_Active(stream, rep, slot, 0, 0), holder))
+            touched.append(cache.var)
+
+            def op(cache=cache, prompt=prompt, slot=slot, holder=holder):
+                try:
+                    with _telemetry.span("decode.prefill", domain="serving",
+                                         tokens=len(prompt)):
+                        last, k_new, v_new = self.programs.prefill(prompt)
+                        k, v = self.programs.admit(
+                            cache.k_slab, cache.v_slab, k_new, v_new, slot)
+                        cache.swap_slabs(k, v)
+                        holder["token"] = int(np.asarray(last).argmax())
+                except Exception as e:          # noqa: BLE001
+                    holder["error"] = e
+
+            _engine.push(op, mutable_vars=[cache.var], name="decode.prefill")
+        if not admitted:
+            return
+        _engine.fence(touched).wait()
+        for a, holder in admitted:
+            err = holder.get("error")
+            if err is not None:
+                self.caches[a.replica].free(a.slot)
+                a.stream._fail(ServingError(
+                    "prefill failed: %s" % err, code="dispatch_error"))
+                continue
+            with self._cond:
+                self._active[(a.replica, a.slot)] = a
+            self._emit(a, holder["token"])
+
+    def _emit(self, a: _Active, token: int):
+        """Deliver one sampled token and retire the sequence if done."""
+        a.last_token = token
+        a.generated += 1
+        a.stream._emit(token)
+        self._m_tokens.inc()
+        eos = self.config.eos_id
+        if eos is not None and token == eos:
+            self._retire(a, reason="eos")
+        elif a.generated >= a.stream.max_new_tokens:
+            self._retire(a, reason="max_tokens")
+        elif self.caches[a.replica].length(a.slot) \
+                >= self.programs.capacity:
+            # the next step would write at kv position == capacity (the
+            # write position IS the current length)
+            self._retire(a, reason="capacity")
+
+    def _step_all(self):
+        """One decode step on every replica with occupied slots: push all
+        step ops, fence once, then sample/stream on the host."""
+        stepped = []          # (replica, [active...], holder)
+        touched = []
+        with self._cond:
+            by_rep: Dict[int, List[_Active]] = {}
+            for (rep, _slot), a in self._active.items():
+                by_rep.setdefault(rep, []).append(a)
+        for rep, actives in sorted(by_rep.items()):
+            cache = self.caches[rep]
+            lengths = np.zeros(cache.slots, np.int32)
+            tokens = np.zeros(cache.slots, np.int32)
+            for a in actives:
+                lengths[a.slot] = cache.length(a.slot)
+                tokens[a.slot] = a.last_token
+            holder: Dict[str, object] = {}
+            stepped.append((rep, actives, holder))
+            touched.append(cache.var)
+
+            def op(cache=cache, lengths=lengths, tokens=tokens,
+                   holder=holder):
+                try:
+                    with _telemetry.span("decode.step", domain="serving",
+                                         rows=int((lengths > 0).sum())):
+                        logits, k, v = self.programs.decode(
+                            cache.k_slab, cache.v_slab, lengths, tokens)
+                        cache.swap_slabs(k, v)
+                        holder["logits"] = np.asarray(logits)
+                except Exception as e:          # noqa: BLE001
+                    holder["error"] = e
+
+            cs = self._captures[rep] if rep < len(self._captures) else None
+            if cs is not None:
+                cs.begin_step()
+                cs.push(op, mutable_vars=[cache.var], name="decode.step")
+                cs.end_step()
+            else:
+                _engine.push(op, mutable_vars=[cache.var],
+                             name="decode.step")
+        if not stepped:
+            return
+        _engine.fence(touched).wait()
+        self.steps += 1
+        for rep, actives, holder in stepped:
+            err = holder.get("error")
+            if err is not None:
+                # donation may have consumed the slabs — rebuild the
+                # replica rather than risk stepping on poisoned state
+                for a in actives:
+                    self._retire(a, error=ServingError(
+                        "decode step failed: %s" % err,
+                        code="dispatch_error"))
+                self.caches[rep].reset()
+                continue
+            logits = holder["logits"]
+            for a in actives:
+                self.caches[rep].advance(a.slot)
+                self._emit(a, int(logits[a.slot].argmax()))
+
+    # --- introspection ----------------------------------------------------
+    def stats(self) -> Dict[str, int]:
+        with self._cond:
+            queued = len(self._queue)
+            active = len(self._active)
+        return {"compiles": self.programs.compiles,
+                "disk_hits": self.programs.disk_hits,
+                "steps": self.steps, "queued": queued, "active": active}

@@ -37,6 +37,8 @@ from .. import progcache as _progcache
 from .. import telemetry
 from .batcher import BatchFormer, Request, ServingError
 from .bucket_cache import BucketCache
+from .generate import (DecodeModel, DecodeScheduler, DecodeSpec,
+                       GenerateConfig, TokenStream)
 from .metrics import ServingBatchEndParam, ServingMetrics
 from .staging import StagingPool
 from .tuner import BucketTuner
@@ -124,7 +126,8 @@ class InferenceServer:
                  dtype: str = "float32",
                  config: Optional[ServingConfig] = None,
                  batch_end_callback: Optional[Callable] = None,
-                 devices: Optional[Sequence] = None):
+                 devices: Optional[Sequence] = None,
+                 decode: Optional[GenerateConfig] = None):
         self.config = config or ServingConfig()
         if not self.config.buckets:
             raise ServingError("no buckets configured")
@@ -184,6 +187,21 @@ class InferenceServer:
             cache_stats_fn=self._cache_stats,
             router_inflight_fn=self._router_inflight,
             ladder_version_fn=lambda: self._ladder_version)
+        # continuous-batching decode (serving/generate): the scheduler
+        # builds its own fixed-shape program set from the SAME loaded
+        # weights the fixed-path predictors use, with its own per-replica
+        # KV engine vars — the two workloads share the engine worker pool
+        # and the telemetry registry but never each other's state
+        self._decode: Optional[DecodeScheduler] = None
+        if decode is not None:
+            base = self._replicas[0].cache._base
+            dm = DecodeModel.from_arg_params(
+                base._arg_params,
+                DecodeSpec(num_heads=decode.num_heads,
+                           num_kv_heads=decode.num_kv_heads,
+                           rope_base=decode.rope_base), dtype=dtype)
+            self._decode = DecodeScheduler(dm, decode, replicas=n_rep)
+
         self._former = self._make_former()
         self._nbatch = 0
         self._thread: Optional[threading.Thread] = None
@@ -252,6 +270,8 @@ class InferenceServer:
         self._thread = threading.Thread(target=self._former_loop,
                                         daemon=True, name="serving-former")
         self._thread.start()
+        if self._decode is not None:
+            self._decode.start()
         return self
 
     def stop(self, drain: bool = True,
@@ -265,6 +285,10 @@ class InferenceServer:
         requests right away with a ``shutdown`` ServingError. In-flight
         dispatches always finish either way. Once ``stop`` returns the
         server is plain stopped: later submits raise ``shutdown``."""
+        if self._decode is not None:
+            # token streams drain (or fail) on the same policy as the
+            # queued fixed-shape requests, under the same deadline
+            self._decode.stop(drain=drain, deadline_ms=deadline_ms)
         if not self._started:
             self._former.close()
             self._former.fail_pending()
@@ -363,6 +387,49 @@ class InferenceServer:
         t = self.config.timeout_ms if timeout_ms is None else timeout_ms
         wait = (t / 1e3 + 60.0) if t and t > 0 else None
         return req.get(wait)
+
+    # --- autoregressive decode (serving/generate) -------------------------
+    def submit_stream(self, prompt: Sequence[int],
+                      max_new_tokens: Optional[int] = None,
+                      timeout_ms: Optional[float] = None) -> TokenStream:
+        """Enqueue one generate request; returns a :class:`TokenStream`
+        that yields token ids as the continuous-batching scheduler decodes
+        them. ``timeout_ms`` is a whole-stream deadline (queued OR
+        decoding; default none — decode requests outlive the fixed-path
+        ``timeout_ms`` scale by design). Raises ServingError with the
+        batcher's structured codes (``queue_full``, ``too_large``,
+        ``shutting_down``, ``shutdown``, ``deadline_exceeded``, ...)."""
+        if self._decode is None:
+            raise ServingError(
+                "decode is not configured — construct the server with "
+                "decode=GenerateConfig(num_heads=...)")
+        if not self._started:
+            raise ServingError("server not started", "shutdown")
+        telemetry.instant("serving.submit_stream", domain="serving",
+                          prompt=len(prompt))
+        try:
+            return self._decode.submit(prompt, max_new_tokens,
+                                       timeout_ms=timeout_ms)
+        except ServingError as e:
+            self.metrics.record_error(e.code)
+            raise
+
+    def generate(self, prompt: Sequence[int],
+                 max_new_tokens: Optional[int] = None,
+                 timeout_ms: Optional[float] = None) -> List[int]:
+        """Synchronous convenience: submit_stream + wait for the full
+        token list."""
+        stream = self.submit_stream(prompt, max_new_tokens,
+                                    timeout_ms=timeout_ms)
+        wait = None if timeout_ms is None else timeout_ms / 1e3 + 60.0
+        return stream.tokens(wait)
+
+    def decode_stats(self) -> Dict:
+        """Decode-side counters: fresh compiles, progcache disk hits,
+        steps taken, queued/active stream counts."""
+        if self._decode is None:
+            raise ServingError("decode is not configured")
+        return self._decode.stats()
 
     # --- former loop + dispatch -------------------------------------------
     def _former_loop(self):

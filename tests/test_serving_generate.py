@@ -1,0 +1,424 @@
+"""mxnet_tpu.serving.generate — continuous-batching decode tests.
+
+Acceptance gates (ISSUE 9): (a) cached decode matches full-context
+re-prefill step-for-step (tight atol on CPU), (b) a sequence's token
+stream is IDENTICAL regardless of which other sequences share the batch,
+including a mid-stream join/finish shuffle (the continuous-batching
+invariant — bitwise, because every occupancy runs the same fixed-shape
+program and the math is row-local), (c) the fixed-shape program set
+bounds fresh compiles to ladder + decode + admit, (d) Predictor.forward
+is safe for concurrent callers — plus scheduler lifecycle/deadline/
+backpressure units and the decode telemetry surface.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import predict, telemetry
+from mxnet_tpu.models import transformer as transformer_model
+from mxnet_tpu.serving import ServingConfig, ServingError
+from mxnet_tpu.serving.generate import (DecodeModel, DecodePrograms,
+                                        DecodeScheduler, DecodeSpec,
+                                        GenerateConfig, KVCacheManager)
+
+V, D, L, F, H, HKV = 32, 16, 2, 32, 4, 2
+
+
+def _lm_symbol():
+    return transformer_model.get_symbol(
+        num_classes=V, num_layers=L, num_heads=H, model_dim=D, ffn_dim=F,
+        num_kv_heads=HKV)
+
+
+def _lm_params(seed=0):
+    """Random weights under the models/transformer.py naming."""
+    rng = np.random.RandomState(seed)
+    dkv = D // H * HKV
+    p = {"embed_weight": rng.randn(V, D).astype(np.float32) * 0.3}
+    for i in range(L):
+        pre = "layer%d" % i
+        p[pre + "_ln1_gamma"] = np.ones(D, np.float32)
+        p[pre + "_ln1_beta"] = np.zeros(D, np.float32)
+        p[pre + "_q_weight"] = rng.randn(D, D).astype(np.float32) * 0.2
+        p[pre + "_k_weight"] = rng.randn(dkv, D).astype(np.float32) * 0.2
+        p[pre + "_v_weight"] = rng.randn(dkv, D).astype(np.float32) * 0.2
+        p[pre + "_o_weight"] = rng.randn(D, D).astype(np.float32) * 0.2
+        p[pre + "_ln2_gamma"] = np.ones(D, np.float32)
+        p[pre + "_ln2_beta"] = np.zeros(D, np.float32)
+        p[pre + "_ffn1_weight"] = rng.randn(F, D).astype(np.float32) * 0.2
+        p[pre + "_ffn1_bias"] = np.zeros(F, np.float32)
+        p[pre + "_ffn2_weight"] = rng.randn(D, F).astype(np.float32) * 0.2
+        p[pre + "_ffn2_bias"] = np.zeros(D, np.float32)
+    p["lnf_gamma"] = np.ones(D, np.float32)
+    p["lnf_beta"] = np.zeros(D, np.float32)
+    p["pred_weight"] = rng.randn(V, D).astype(np.float32) * 0.2
+    p["pred_bias"] = np.zeros(V, np.float32)
+    return p
+
+
+def _decode_model(seed=0):
+    return DecodeModel.from_arg_params(
+        _lm_params(seed), DecodeSpec(num_heads=H, num_kv_heads=HKV))
+
+
+def _config(**kw):
+    kw.setdefault("slots", 3)
+    kw.setdefault("max_context", 24)
+    kw.setdefault("prefill_buckets", (4, 8))
+    kw.setdefault("max_new_tokens", 8)
+    return GenerateConfig(num_heads=H, num_kv_heads=HKV, **kw)
+
+
+def _softmax(x):
+    e = np.exp(x - x.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+# --- (a) KV-cache correctness ----------------------------------------------
+
+def test_prefill_matches_predictor_forward():
+    """The decode subsystem's prefill program reproduces the Symbol/
+    Predictor forward of the SAME weights — anchors the stacked-param
+    reimplementation to the training-side graph."""
+    sym = _lm_symbol()
+    params = _lm_params()
+    n = 5
+    pred = predict.Predictor(sym.tojson(), params,
+                             {"data": (1, 8), "softmax_label": (1, 8)})
+    ids = np.array([[3, 7, 1, 9, 4, 0, 0, 0]], np.float32)
+    probs = pred.forward(
+        data=ids, softmax_label=np.zeros((1, 8), np.float32)
+    )[0].asnumpy()                                    # (8, V) SoftmaxOutput
+    model = _decode_model()
+    progs = DecodePrograms(model, slots=2, capacity=16, prefill_buckets=(8,))
+    last, _k, _v = progs.prefill([3, 7, 1, 9, 4])
+    got = _softmax(np.asarray(last))
+    np.testing.assert_allclose(got, probs[n - 1], atol=2e-5, rtol=1e-4)
+
+
+def test_cached_decode_matches_reprefill():
+    """Step-level gate: decoding token i against the KV cache produces
+    the same logits as re-running the FULL context (prompt + generated)
+    through prefill — the cache is a perfect memo, not an approximation."""
+    model = _decode_model()
+    progs = DecodePrograms(model, slots=3, capacity=16,
+                           prefill_buckets=(4, 8, 16))
+    cache = KVCacheManager(progs, replica=0)
+    prompt = [3, 7, 1]
+    slot = cache.alloc("seq", len(prompt))
+    last, k_new, v_new = progs.prefill(prompt)
+    k, v = progs.admit(cache.k_slab, cache.v_slab, k_new, v_new, slot)
+    cache.swap_slabs(k, v)
+    ctx = list(prompt)
+    tok = int(np.asarray(last).argmax())
+    for _step in range(6):
+        ctx.append(tok)
+        lengths = np.zeros(progs.slots, np.int32)
+        tokens = np.zeros(progs.slots, np.int32)
+        lengths[slot] = cache.length(slot)
+        tokens[slot] = tok
+        logits, k, v = progs.decode(cache.k_slab, cache.v_slab,
+                                    lengths, tokens)
+        cache.swap_slabs(k, v)
+        cache.advance(slot)
+        step_logits = np.asarray(logits)[slot]
+        ref_last, _rk, _rv = progs.prefill(ctx)    # full-context re-prefill
+        np.testing.assert_allclose(step_logits, np.asarray(ref_last),
+                                   atol=3e-5, rtol=1e-4)
+        tok = int(step_logits.argmax())
+    import mxnet_tpu.engine as engine
+    engine.fence([cache.var]).wait()
+    engine.delete_variable(cache.var)
+
+
+def _run_alone(model, cfg, prompt, max_new):
+    sched = DecodeScheduler(model, cfg, replicas=1)
+    sched.start()
+    try:
+        return sched.submit(prompt, max_new_tokens=max_new).tokens(timeout=60)
+    finally:
+        sched.stop()
+
+
+# --- (b) continuous-batching invariant --------------------------------------
+
+def test_stream_identical_regardless_of_batch_coresidents():
+    """Bitwise: same fixed-shape program at every occupancy + row-local
+    math + per-row length masking ⇒ co-residents can't perturb a stream."""
+    model = _decode_model()
+    cfg = _config(slots=3, max_new_tokens=10)
+    solo_a = _run_alone(model, cfg, [3, 7, 1], 10)
+    solo_b = _run_alone(model, cfg, [5, 2, 8, 6], 6)
+    solo_c = _run_alone(model, cfg, [9, 9, 4, 1, 2], 4)
+    sched = DecodeScheduler(model, cfg, replicas=1)
+    sched.start()
+    try:
+        sa = sched.submit([3, 7, 1], max_new_tokens=10)
+        sb = sched.submit([5, 2, 8, 6], max_new_tokens=6)
+        sc = sched.submit([9, 9, 4, 1, 2], max_new_tokens=4)
+        assert sa.tokens(timeout=60) == solo_a
+        assert sb.tokens(timeout=60) == solo_b
+        assert sc.tokens(timeout=60) == solo_c
+    finally:
+        sched.stop()
+
+
+def test_mid_stream_join_and_finish_shuffle():
+    """Sequences join mid-flight into slots freed by finished ones; the
+    long-running stream must be unaffected by the churn around it."""
+    model = _decode_model()
+    cfg = _config(slots=2, max_new_tokens=16)
+    solo_long = _run_alone(model, cfg, [3, 7, 1], 14)
+    sched = DecodeScheduler(model, cfg, replicas=1)
+    sched.start()
+    try:
+        long_s = sched.submit([3, 7, 1], max_new_tokens=14)
+        # wait until the long stream is demonstrably mid-flight
+        assert long_s.next_token(timeout=60) == solo_long[0]
+        churn = []
+        for i in range(3):   # churn the OTHER slot: join, finish, rejoin
+            s = sched.submit([5 + i, 2, 8], max_new_tokens=2)
+            churn.append(s.tokens(timeout=60))
+        rest = list(long_s)
+        assert [solo_long[0]] + rest == solo_long
+        assert all(len(c) == 2 for c in churn)
+        assert long_s.finish_reason == "max_tokens"
+    finally:
+        sched.stop()
+
+
+# --- (c) bounded compiles ----------------------------------------------------
+
+def test_compile_count_bounded_by_program_set():
+    model = _decode_model()
+    cfg = _config(slots=3, prefill_buckets=(4, 8), max_new_tokens=4)
+    sched = DecodeScheduler(model, cfg, replicas=1)
+    sched.start()
+    try:
+        streams = [sched.submit([1 + i, 2, 3][: 2 + i % 2],
+                                max_new_tokens=2 + i % 3)
+                   for i in range(8)]
+        for s in streams:
+            s.tokens(timeout=120)
+        st = sched.stats()
+        # ladder (2) + decode step (1) + admit (1) per replica
+        assert st["compiles"] + st["disk_hits"] <= 4, st
+        assert st["steps"] > 0
+    finally:
+        sched.stop()
+
+
+# --- scheduler lifecycle / error codes ---------------------------------------
+
+def test_submit_error_codes_and_lifecycle():
+    model = _decode_model()
+    cfg = _config(slots=1, prefill_buckets=(4,), max_context=8,
+                  queue_depth=1, max_new_tokens=2)
+    sched = DecodeScheduler(model, cfg, replicas=1)
+    with pytest.raises(ServingError) as ei:
+        sched.submit([1, 2])
+    assert ei.value.code == "shutdown"          # not started yet
+    sched.start()
+    try:
+        with pytest.raises(ServingError) as ei:
+            sched.submit([1, 2, 3, 4, 5])       # > largest bucket
+        assert ei.value.code == "too_large"
+        with pytest.raises(ServingError) as ei:
+            sched.submit([])
+        assert ei.value.code == "too_large"
+        # occupy the only slot, fill the depth-1 queue, then overflow it
+        a = sched.submit([1, 2], max_new_tokens=12)
+        assert a.next_token(timeout=60) is not None   # slot now claimed
+        queued = sched.submit([1, 2], max_new_tokens=2)
+        with pytest.raises(ServingError) as ei:
+            for _ in range(20):
+                sched.submit([1, 2], max_new_tokens=2)
+        assert ei.value.code == "queue_full"
+        assert a.tokens(timeout=60)                   # capacity-bounded
+        assert a.finish_reason in ("max_tokens", "capacity")
+        assert len(queued.tokens(timeout=60)) == 2
+    finally:
+        sched.stop()
+    # restart works and serves again
+    sched.start()
+    try:
+        assert len(sched.submit([1, 2]).tokens(timeout=60)) == 2
+    finally:
+        sched.stop()
+
+
+def test_queued_deadline_expires():
+    model = _decode_model()
+    cfg = _config(slots=1, prefill_buckets=(4,), max_new_tokens=24,
+                  max_context=32)
+    sched = DecodeScheduler(model, cfg, replicas=1)
+    sched.start()
+    try:
+        hog = sched.submit([1, 2], max_new_tokens=24)   # occupies the slot
+        doomed = sched.submit([3, 4], timeout_ms=1.0)
+        with pytest.raises(ServingError) as ei:
+            doomed.tokens(timeout=60)
+        assert ei.value.code == "deadline_exceeded"
+        assert len(hog.tokens(timeout=120)) == 24
+    finally:
+        sched.stop()
+
+
+def test_cancel_frees_slot_mid_stream():
+    model = _decode_model()
+    cfg = _config(slots=1, max_new_tokens=24, max_context=32)
+    sched = DecodeScheduler(model, cfg, replicas=1)
+    sched.start()
+    try:
+        s = sched.submit([1, 2, 3], max_new_tokens=24)
+        assert s.next_token(timeout=60) is not None
+        s.cancel()
+        s.tokens(timeout=60)
+        assert s.finish_reason == "cancelled"
+        # the freed slot serves the next stream
+        assert len(sched.submit([4, 5]).tokens(timeout=60)) == 24
+    finally:
+        sched.stop()
+
+
+def test_stop_drain_finishes_streams_and_shutdown_fails_them():
+    model = _decode_model()
+    cfg = _config(slots=2, max_new_tokens=12, max_context=32)
+    sched = DecodeScheduler(model, cfg, replicas=1)
+    sched.start()
+    s = sched.submit([1, 2, 3], max_new_tokens=12)
+    assert s.next_token(timeout=60) is not None   # mid-stream
+    sched.stop(drain=True, deadline_ms=60000)
+    assert s.done and s.finish_reason == "max_tokens"
+    assert len([s] + []) == 1 and len(s.tokens()) == 12
+    with pytest.raises(ServingError) as ei:
+        sched.submit([1, 2])
+    assert ei.value.code == "shutdown"
+    # hard stop fails in-flight work with code=shutdown
+    sched.start()
+    s2 = sched.submit([1, 2, 3], max_new_tokens=12)
+    sched.stop(drain=False)
+    with pytest.raises(ServingError) as ei:
+        s2.tokens(timeout=60)
+    assert ei.value.code in ("shutdown",)
+
+
+# --- (d) Predictor thread-safety --------------------------------------------
+
+def _mlp_symbol():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_predictor_concurrent_forward_two_threads():
+    """Known sharp edge before this PR: forward() staged inputs/outputs on
+    shared instance state, so two callers could read each other's rows.
+    Now each caller must get exactly the output of ITS input."""
+    sym = _mlp_symbol()
+    rng = np.random.RandomState(0)
+    shapes, _, _ = sym.infer_shape(data=(1, 10))
+    params = {n: rng.uniform(-0.5, 0.5, s).astype(np.float32)
+              for n, s in zip(sym.list_arguments(), shapes)
+              if n not in ("data", "softmax_label")}
+    pred = predict.Predictor(sym.tojson(), params, {"data": (1, 10)})
+    xs = rng.uniform(-1, 1, (2, 40, 1, 10)).astype(np.float32)
+    want = [[pred.forward(data=x)[0].asnumpy() for x in xs[t]]
+            for t in range(2)]
+    got = [[None] * 40, [None] * 40]
+    errs = []
+    barrier = threading.Barrier(2)
+
+    def worker(t):
+        try:
+            barrier.wait()
+            for i in range(40):
+                got[t][i] = pred.forward(data=xs[t][i])[0].asnumpy()
+        except Exception as e:             # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(2)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs, errs
+    for t in range(2):
+        for i in range(40):
+            np.testing.assert_array_equal(got[t][i], want[t][i])
+
+
+# --- telemetry ---------------------------------------------------------------
+
+def test_decode_metrics_exported():
+    model = _decode_model()
+    cfg = _config(slots=2, max_new_tokens=4)
+    sched = DecodeScheduler(model, cfg, replicas=1)
+    before = dict(telemetry.registry.counter(
+        "decode_tokens_total").get_name_value())["decode_tokens_total"]
+    sched.start()
+    try:
+        toks = sched.submit([1, 2, 3], max_new_tokens=4).tokens(timeout=60)
+    finally:
+        sched.stop(drain=True, deadline_ms=60000)
+    after = dict(telemetry.registry.counter(
+        "decode_tokens_total").get_name_value())["decode_tokens_total"]
+    assert after - before >= len(toks) == 4
+    text = telemetry.registry.exposition()
+    assert "decode_tokens_total" in text
+    assert "decode_batch_occupancy_pct" in text
+    assert "kv_bytes" in text
+
+
+# --- server front door -------------------------------------------------------
+
+def test_server_generate_front_door_with_mixed_traffic():
+    sym = _lm_symbol()
+    params = _lm_params()
+    cfg = ServingConfig(buckets=(1, 2), max_delay_ms=5.0,
+                        timeout_ms=10000.0, replicas=1)
+    srv = mx.serving.InferenceServer(
+        sym, params, {"data": (8,), "softmax_label": (8,)}, config=cfg,
+        decode=_config(slots=2, max_new_tokens=6))
+    with pytest.raises(ServingError):
+        srv.submit_stream([1, 2, 3])           # not started
+    with srv:
+        # the fixed-shape path lives alongside decode on one server; this
+        # LM's (batch*seq, V) output violates the fixed path's pre-existing
+        # batch-major contract, so it fails with ITS structured code while
+        # decode streams keep flowing — neither path disturbs the other
+        ids = np.array([[3, 7, 1, 9, 4, 0, 0, 0]], np.float32)
+        with pytest.raises(ServingError, match="batch-major"):
+            srv.predict(data=ids,
+                        softmax_label=np.zeros((1, 8), np.float32))
+        stream = srv.submit_stream([3, 7, 1], max_new_tokens=6)
+        toks = [t for t in stream]
+        assert len(toks) == 6
+        assert srv.generate([3, 7, 1], max_new_tokens=6) == toks
+        st = srv.decode_stats()
+        assert st["compiles"] + st["disk_hits"] <= len(
+            _config().prefill_buckets) + 2
+    with pytest.raises(ServingError):
+        srv.submit_stream([1, 2, 3])           # stopped again
+
+
+def test_server_without_decode_config_raises():
+    sym = _mlp_symbol()
+    rng = np.random.RandomState(0)
+    shapes, _, _ = sym.infer_shape(data=(1, 10))
+    params = {n: rng.uniform(-0.1, 0.1, s).astype(np.float32)
+              for n, s in zip(sym.list_arguments(), shapes)
+              if n not in ("data", "softmax_label")}
+    srv = mx.serving.InferenceServer(
+        sym, params, {"data": (10,)},
+        config=ServingConfig(buckets=(1, 2), max_delay_ms=5.0))
+    with srv:
+        with pytest.raises(ServingError):
+            srv.submit_stream([1, 2])
+        with pytest.raises(ServingError):
+            srv.decode_stats()
