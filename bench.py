@@ -688,6 +688,60 @@ def run_engine_config():
         "bench bug: replay arm ran eagerly (%d replays, %d bails)" \
         % (cs.replays, cs.bails)
     speedup = eager_per_op / replay_per_op
+
+    # --- happens-before sanitizer overhead A/B ---------------------------
+    # Claim under test (docs/concurrency.md): with MXNET_ENGINE_SANITIZER
+    # off, the push-path hook is one global load + is-None branch. Arm A
+    # is a hook-free twin of the module push wrapper (same in-flight
+    # accounting, same engine call — minus the sanitizer branch); arm B is
+    # engine.push with the sanitizer disabled. Arms run BACK-TO-BACK per
+    # repeat and the overhead is the median of the per-repeat paired
+    # ratios (the checkpoint bench's drift-immune idiom) — gate < 1%.
+    # Arm C (sanitizer ENABLED, no guards) rides along as the informative
+    # cost of actually turning the tool on: per-push site capture + the
+    # closure reachability scan.
+    eng = engine.get()
+
+    def push_nohook(fn, c, m, nm):
+        counted = engine._inflight_begin(tuple(c) + tuple(m))
+        if counted:
+            fn = engine._wrap_inflight_sync(fn, counted)
+        eng.push(fn, c, m, 0, nm)
+
+    def nohook_iter():
+        for c, m, nm in sigs:
+            push_nohook(nop, c, m, nm)
+
+    was_on = engine.sanitizer_enabled()
+    engine.sanitizer_enable(False)
+    nohook_iter()
+    drain()
+    san_times = {"nohook": [], "disabled": [], "enabled": []}
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            nohook_iter()
+        san_times["nohook"].append(time.perf_counter() - t0)
+        drain()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            eager_iter()
+        san_times["disabled"].append(time.perf_counter() - t0)
+        drain()
+        engine.sanitizer_enable(True)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            eager_iter()
+        san_times["enabled"].append(time.perf_counter() - t0)
+        engine.sanitizer_enable(False)
+        drain()
+    engine.sanitizer_enable(was_on)
+    san_disabled_pct = statistics.median(
+        (d - n) / n * 100.0
+        for d, n in zip(san_times["disabled"], san_times["nohook"]))
+    san_enabled_pct = statistics.median(
+        (e - n) / n * 100.0
+        for e, n in zip(san_times["enabled"], san_times["nohook"]))
     return {
         "metric": "engine_dispatch_overhead",
         "value": round(speedup, 2),
@@ -702,6 +756,10 @@ def run_engine_config():
         "iters": iters,
         "repeats": repeats,
         "replays": cs.replays,
+        # the < 1% gate: disabled sanitizer must be free on the push path
+        # (negative = noise = pass); enabled cost is informative only
+        "sanitizer_disabled_overhead_pct": round(san_disabled_pct, 3),
+        "sanitizer_enabled_overhead_pct": round(san_enabled_pct, 3),
         "engine": type(engine.get()).__name__,
     }
 

@@ -43,11 +43,22 @@ echo "== stage 5: serving tests (dynamic batching + bucketed compile cache) =="
 # its own stage where a hang or flake is attributable. Then the end-to-end
 # dry-run: concurrent clients -> occupancy/cache-hit assertions.
 JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py tests/test_serving_generate.py -q
-JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; g.dryrun_serving()"
+# Both end-to-end dry-runs below run with the engine happens-before
+# sanitizer ON: the serving/decode dispatch paths must produce ZERO race
+# reports (docs/concurrency.md sanitizer section).
+JAX_PLATFORMS=cpu MXNET_ENGINE_SANITIZER=1 python -c "
+import __graft_entry__ as g; g.dryrun_serving()
+from mxnet_tpu import engine
+assert engine.sanitizer_reports() == [], engine.sanitizer_reports()
+print('sanitizer: 0 reports (serving)')"
 # Continuous-batching decode gate: staggered generate streams must emit
 # token streams identical to sequential generation, with fresh compiles
 # bounded by the fixed program set and a clean mid-stream drain.
-JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; g.dryrun_decode()"
+JAX_PLATFORMS=cpu MXNET_ENGINE_SANITIZER=1 python -c "
+import __graft_entry__ as g; g.dryrun_decode()
+from mxnet_tpu import engine
+assert engine.sanitizer_reports() == [], engine.sanitizer_reports()
+print('sanitizer: 0 reports (decode)')"
 # Warm-restart gate (persistent progcache): a cold process populates the
 # cache and tunes its ladder, then a SECOND process over the same cache
 # dir must serve the same traffic with 0 fresh bucket compiles (ladder
@@ -63,15 +74,17 @@ assert mx.libinfo.find_lib_path()
 print("import OK; ops:", len(mx.ops.registry.OP_REGISTRY))
 EOF
 
-echo "== stage 7: static analysis (lock-order / engine / purity / progcache-io) =="
+echo "== stage 7: static analysis (lock-order / engine / purity / progcache-io / racecheck) =="
 # Pure-AST gate, independent of the pytest tiers: the shipped tree must
 # produce no findings beyond ci/analysis_baseline.json (each baselined
 # entry carries a written justification). Fails on ANY new finding.
-JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis --fail-on-new
+# Budget: the full-tree pass must finish inside 15s (docs/static_analysis.md).
+timeout -k 5 15 env JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis --fail-on-new
 # Self-check: the known-bad fixtures must trip the gate (a silently
 # lobotomized analyzer would otherwise pass CI forever).
 for bad in abba_deadlock undeclared_mutable impure_jit telemetry_in_jit \
-        capture_unstable raw_write_progcache; do
+        capture_unstable raw_write_progcache \
+        undeclared_var_access unfenced_host_read var_use_after_delete; do
     if JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis \
             --root "tests/fixtures/analysis/${bad}.py" \
             --baseline none --fail-on-new >/dev/null 2>&1; then
@@ -88,7 +101,13 @@ echo "== stage 8: fault-injection dry-run (kill-a-rank recovery, CPU) =="
 # async sharded checkpoint and replays to BIT-IDENTICAL weights; the
 # dp=4 -> 2 -> 4 resharding round-trip is checked bitwise in the same
 # entry point (docs/fault_tolerance.md).
+# The sanitizer rides along: fault injection + recovery must not surface
+# any undeclared access — races and injected faults are distinct defects.
 JAX_PLATFORMS=cpu MXNET_FAULT_PLAN="kill_rank rank=1 step=5" \
-    python -c "import __graft_entry__ as g; g.dryrun_fault_tolerance()"
+    MXNET_ENGINE_SANITIZER=1 python -c "
+import __graft_entry__ as g; g.dryrun_fault_tolerance()
+from mxnet_tpu import engine
+assert engine.sanitizer_reports() == [], engine.sanitizer_reports()
+print('sanitizer: 0 reports (fault dryrun)')"
 
 echo "ALL CI STAGES PASSED"

@@ -13,6 +13,12 @@ Three pure-``ast`` checkers (no module under analysis is imported):
                         in a progcache module goes through the atomic
                         tmp+``os.replace`` helper (no raw
                         ``open(path, 'wb')`` commits)
+- :mod:`.racecheck`     happens-before discipline: undeclared state
+                        touched by pushed closures (interprocedural,
+                        through aliases/helpers), host reads of pushed
+                        state with no fence between, engine-var use
+                        after ``delete_variable`` — the static half of
+                        the ``MXNET_ENGINE_SANITIZER`` pair
 
 Run ``python -m mxnet_tpu.analysis --fail-on-new`` (the CI gate) or use
 :func:`run_analysis` programmatically. Findings carry stable fingerprints;
@@ -28,14 +34,15 @@ from .core import (Finding, SourceModule, dedupe, diff_against_baseline,
 from .lockorder import LOCK_HIERARCHY
 from .witness import LockOrderWitness
 
-CHECKERS = ("lockorder", "engine", "purity", "progcache_io")
+CHECKERS = ("lockorder", "engine", "purity", "progcache_io", "racecheck")
 
 
 def run_analysis(root: str,
                  checks: Optional[Sequence[str]] = None) -> List[Finding]:
     """Run the selected checkers (default: all) over every ``*.py`` under
     ``root`` and return deduped, location-sorted findings."""
-    from . import engine_lint, lockorder, progcache_io, trace_purity
+    from . import (engine_lint, lockorder, progcache_io, racecheck,
+                   trace_purity)
     checks = tuple(checks) if checks else CHECKERS
     modules = load_modules(root)
     findings: List[Finding] = []
@@ -47,6 +54,8 @@ def run_analysis(root: str,
         findings += trace_purity.check(modules)
     if "progcache_io" in checks:
         findings += progcache_io.check(modules)
+    if "racecheck" in checks:
+        findings += racecheck.check(modules)
     return dedupe(findings)
 
 

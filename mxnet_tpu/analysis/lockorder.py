@@ -65,6 +65,9 @@ LOCK_HIERARCHY: Dict[str, int] = {
     # capture/replay state machine: leaf — state flips only; pushes,
     # callbacks, and logging all happen outside the hold.
     "engine.CapturedSequence._lock": 100,
+    # happens-before sanitizer shadow tables: leaf — epoch/guard bookkeeping
+    # only; report logging and the telemetry counter inc happen after release.
+    "engine._san_lock": 100,
     # serving: former condition and metrics lock are PEERS — the PR 2 ABBA
     # contract: neither side calls into the other under its own lock.
     "serving.batcher.BatchFormer._cond": 50,

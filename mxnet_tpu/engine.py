@@ -27,11 +27,13 @@ debugging — the reference's own advice, threaded_engine.h:326-338).
 from __future__ import annotations
 
 import ctypes
+import functools
 import json
 import logging
 import os
 import threading
 import traceback
+import types
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from . import telemetry as _telemetry
@@ -355,14 +357,21 @@ def get() -> "NativeEngine | PythonEngine":
 
 # module-level conveniences mirroring the reference's C API surface
 def new_variable():
-    return get().new_variable()
+    v = get().new_variable()
+    if _san is not None:
+        _san.on_new(v)
+    return v
 
 
 def delete_variable(var):
+    if _san is not None:
+        _san.on_delete(var)
     get().delete_variable(var)
 
 
 def push(fn, const_vars=(), mutable_vars=(), priority=0, name="op"):
+    if _san is not None:
+        _san.on_push(fn, const_vars, mutable_vars, name)
     counted = _inflight_begin(tuple(const_vars) + tuple(mutable_vars))
     if counted:
         fn = _wrap_inflight_sync(fn, counted)
@@ -370,6 +379,8 @@ def push(fn, const_vars=(), mutable_vars=(), priority=0, name="op"):
 
 
 def push_async(fn, const_vars=(), mutable_vars=(), priority=0, name="op"):
+    if _san is not None:
+        _san.on_push(fn, const_vars, mutable_vars, name)
     counted = _inflight_begin(tuple(const_vars) + tuple(mutable_vars))
     if counted:
         fn = _wrap_inflight_async(fn, counted)
@@ -378,11 +389,15 @@ def push_async(fn, const_vars=(), mutable_vars=(), priority=0, name="op"):
 
 def wait_for_var(var):
     get().wait_for_var(var)
+    if _san is not None:
+        _san.on_sync((int(var),))
 
 
 def wait_for_all():
     with _telemetry.span("engine.wait_for_all", domain="engine"):
         get().wait_for_all()
+    if _san is not None:
+        _san.on_sync(None)
     _raise_pending_file_error()
 
 
@@ -397,9 +412,11 @@ class Fence:
     per-var ``wait_for_var`` loop provides only one var at a time.
     """
 
-    def __init__(self, event: threading.Event, n_vars: int):
+    def __init__(self, event: threading.Event, n_vars: int,
+                 fence_vars: Sequence[int] = ()):
         self._event = event
         self.n_vars = n_vars
+        self._fence_vars = tuple(fence_vars)
 
     def done(self) -> bool:
         """True once the barrier op has run (non-blocking probe)."""
@@ -414,6 +431,10 @@ class Fence:
             raise MXNetError(
                 "engine fence over %d var(s) not reached after %.3fs"
                 % (self.n_vars, timeout))
+        if _san is not None and self._fence_vars:
+            # the fence completed: every DECLARED access enqueued before it
+            # on these vars happened-before this point
+            _san.on_sync(self._fence_vars)
         return self
 
 
@@ -432,8 +453,10 @@ def fence(vars: Sequence[int], priority: int = 0,
     """
     ev = threading.Event()
     vs = list(vars)
+    if _san is not None:
+        _san.on_fence(vs, name)
     get().push(ev.set, const_vars=vs, priority=priority, name=name)
-    return Fence(ev, len(vs))
+    return Fence(ev, len(vs), fence_vars=vs)
 
 
 # --- capture/replay of steady-state dispatch sequences -----------------------
@@ -724,6 +747,8 @@ class CapturedSequence:
             tok = _telemetry.begin("engine.replay", domain="engine",
                                    ops=len(_ops), sequence=seq_name) \
                 if on_engine else None
+            san = _san  # read once per replay: tests may toggle mid-run
+            conf = san.replay_conflicts(_ops) if san is not None else None
             events: List[Optional[threading.Event]] = [None] * len(_ops)
             for i, (sig, deps) in enumerate(_ops):
                 is_async, opname = sig[0], sig[1]
@@ -731,6 +756,10 @@ class CapturedSequence:
                     ev = events[d]
                     if ev is not None:  # sync deps completed in program order
                         ev.wait()
+                if conf is not None:
+                    # after the declared-edge waits, every conflicting
+                    # predecessor must already be done — or an edge is missing
+                    san.on_replay_child(seq_name, i, _ops, conf, events)
                 fn = _slots[i]
                 try:
                     if is_async:
@@ -769,6 +798,325 @@ class CapturedSequence:
 
         push_async(replay, self._union[0], self._union[1],
                    name="replay:%s" % seq_name)
+
+
+# --- happens-before sanitizer (MXNET_ENGINE_SANITIZER) -----------------------
+# Dynamic half of mxnet_tpu.analysis.racecheck: with MXNET_ENGINE_SANITIZER=1
+# (or sanitizer_enable()), every module-level push is checked against shadow
+# epochs per engine var. Host state registered with guard_state(obj, var) is
+# found by a bounded reachability scan over the pushed fn (closure cells,
+# defaults, functools.partial, bound-method instances — one helper level
+# deep); reaching it without declaring its var, while a prior access is not
+# yet settled by a fence/wait on that var, is a race: the engine has no edge
+# ordering the two ops. Checks run at push time only — op fns execute exactly
+# as without the sanitizer (so MXNET_FAULT_PLAN composes untouched). Replays
+# additionally validate that CapturedSequence's pre-resolved edge set
+# dominates the conflict set: when a child starts, every conflicting async
+# predecessor's done-event must already be set (declared edges + program
+# order make that transitively true iff no edge is missing).
+#
+# Disabled path: `_san` stays None and every hook is one global load + branch.
+_san = None
+_san_lock = threading.Lock()  # leaf (rank 100): guards shadow tables only
+
+
+def _san_site() -> str:
+    """First stack frame outside this file — the user-visible push site."""
+    for fr in reversed(traceback.extract_stack(limit=12)[:-2]):
+        if not fr.filename.endswith("engine.py"):
+            return "%s:%d" % (os.path.basename(fr.filename), fr.lineno)
+    return "<engine>"
+
+
+class _ShadowVar:
+    __slots__ = ("epoch", "decl_epoch", "synced", "last", "deleted")
+
+    def __init__(self):
+        self.epoch = 0       # every tracked access, declared or undeclared
+        self.decl_epoch = 0  # high-water mark of declared accesses only
+        self.synced = 0      # decl_epoch as of the last fence/wait on the var
+        self.last = None     # (op, site, mode, declared-var frozenset)
+        self.deleted = None  # site of delete_variable once deleted
+
+    def settled(self) -> bool:
+        return self.epoch <= self.synced
+
+
+class _Sanitizer:
+    """Shadow-state tracker behind the module-level engine API."""
+
+    MAX_REPORTS = 1000
+
+    def __init__(self):
+        self._vars: Dict[int, _ShadowVar] = {}
+        # id(obj) -> (obj, var, desc); strong refs so ids are never reused
+        self._guards: Dict[int, Tuple[object, int, str]] = {}
+        self.reports: List[dict] = []
+
+    # -- guard registry ------------------------------------------------------
+    def guard(self, obj, var, desc):
+        with _san_lock:
+            self._guards[id(obj)] = (obj, int(var), desc)
+
+    def unguard(self, obj):
+        with _san_lock:
+            self._guards.pop(id(obj), None)
+
+    def _reachable_guards(self, fn):
+        """Guarded objects reachable from a pushed callable. Lock-free: only
+        dict probes on the guard registry (GIL-atomic)."""
+        found, seen = [], set()
+        stack = [(fn, 2)]
+        budget = 256
+        while stack and budget:
+            obj, depth = stack.pop()
+            oid = id(obj)
+            if oid in seen:
+                continue
+            seen.add(oid)
+            budget -= 1
+            hit = self._guards.get(oid)
+            if hit is not None and hit[0] is obj:
+                found.append((hit[1], hit[2]))
+                continue
+            if depth <= 0:
+                continue
+            if isinstance(obj, functools.partial):
+                stack.append((obj.func, depth))
+                stack.extend((a, depth) for a in obj.args)
+                stack.extend((v, depth) for v in obj.keywords.values())
+            elif isinstance(obj, (list, tuple, set, frozenset)):
+                stack.extend((e, depth) for e in list(obj)[:32])
+            elif isinstance(obj, dict):
+                stack.extend((v, depth) for v in list(obj.values())[:32])
+            elif isinstance(obj, (types.ModuleType, type)):
+                pass  # never walk module/class namespaces
+            else:
+                inst = getattr(obj, "__self__", None)
+                if inst is not None and not isinstance(
+                        inst, (types.ModuleType, type)):
+                    stack.append((inst, depth - 1))
+                f = getattr(obj, "__func__", obj)
+                cells = getattr(f, "__closure__", None)
+                if cells:
+                    for c in cells:
+                        try:
+                            stack.append((c.cell_contents, depth - 1))
+                        except ValueError:  # empty cell
+                            pass
+                dfl = getattr(f, "__defaults__", None)
+                if dfl:
+                    stack.extend((v, depth - 1) for v in dfl)
+                code = getattr(f, "__code__", None)
+                gl = getattr(f, "__globals__", None)
+                if code is not None and gl is not None:
+                    # module-global state (and global helpers) the fn names
+                    for nm in code.co_names[:32]:
+                        if nm in gl:
+                            stack.append((gl[nm], depth - 1))
+                if not callable(obj):
+                    d = getattr(obj, "__dict__", None)
+                    if isinstance(d, dict):
+                        stack.extend(
+                            (v, depth - 1) for v in list(d.values())[:64])
+        return found
+
+    # -- hooks (called from the module-level wrappers) -----------------------
+    def on_new(self, var):
+        with _san_lock:
+            self._vars.pop(int(var), None)
+
+    def on_delete(self, var):
+        site = _san_site()
+        with _san_lock:
+            self._vars.setdefault(int(var), _ShadowVar()).deleted = site
+
+    def on_sync(self, vars):
+        """A wait completed: declared accesses on `vars` (all vars if None)
+        happened-before this point. Undeclared epochs stay unsettled — a
+        fence only covers ops the engine knew about."""
+        with _san_lock:
+            if vars is None:
+                cells = list(self._vars.values())
+            else:
+                cells = [self._vars[v] for v in (int(x) for x in vars)
+                         if v in self._vars]
+            for cell in cells:
+                cell.synced = cell.decl_epoch
+
+    def on_fence(self, vars, name):
+        site = _san_site()
+        out = []
+        with _san_lock:
+            for v in (int(x) for x in vars):
+                cell = self._vars.get(v)
+                if cell is not None and cell.deleted is not None:
+                    out.append(self._mk(
+                        "var-use-after-delete", v, name, site,
+                        "delete_variable", cell.deleted,
+                        detail="fence names var %d after deletion" % v))
+        for rep in out:
+            self._emit(rep)
+
+    def on_push(self, fn, const_vars, mutable_vars, name):
+        site = _san_site()
+        mut = {int(v) for v in mutable_vars}
+        declared = {int(v) for v in const_vars} | mut
+        touched = self._reachable_guards(fn)
+        out = []
+        with _san_lock:
+            for v in sorted(declared):
+                cell = self._vars.get(v)
+                if cell is not None and cell.deleted is not None:
+                    out.append(self._mk(
+                        "var-use-after-delete", v, name, site,
+                        "delete_variable", cell.deleted,
+                        detail="op declares var %d after deletion" % v))
+            for v, desc in touched:
+                if v in declared:
+                    continue  # ordered: the engine sees this access
+                cell = self._vars.setdefault(v, _ShadowVar())
+                last = cell.last
+                # a shared declared var with the previous access orders the
+                # two ops even though this one skips the guard var
+                if not cell.settled() and last is not None \
+                        and not (declared & last[3]):
+                    out.append(self._mk(
+                        "undeclared-var-access", v, name, site,
+                        last[0], last[1],
+                        detail="op reaches state %r guarded by var %d "
+                               "without declaring it" % (desc, v)))
+                cell.epoch += 1
+                cell.last = (name, site, "undeclared", frozenset(declared))
+            for v in sorted(declared):
+                cell = self._vars.setdefault(v, _ShadowVar())
+                last = cell.last
+                if not cell.settled() and last is not None \
+                        and last[2] == "undeclared" \
+                        and not (declared & last[3]):
+                    out.append(self._mk(
+                        "undeclared-var-access", v, name, site,
+                        last[0], last[1],
+                        detail="declared access races the earlier "
+                               "undeclared access to var %d" % v))
+                cell.epoch += 1
+                cell.decl_epoch = cell.epoch
+                cell.last = (name, site,
+                             "write" if v in mut else "read",
+                             frozenset(declared))
+        for rep in out:
+            self._emit(rep)
+
+    # -- replay validation ---------------------------------------------------
+    @staticmethod
+    def replay_conflicts(ops):
+        """Full conflict-predecessor map over a captured sequence: for each
+        child, every earlier child sharing a var with at least one writer.
+        The pre-resolved edge set must dominate this."""
+        conf = []
+        writers: Dict[int, List[int]] = {}
+        readers: Dict[int, List[int]] = {}
+        for i, (sig, _deps) in enumerate(ops):
+            const, mutv = _dedup(sig[3], sig[4])
+            c = set()
+            for v in const:
+                c.update(writers.get(v, ()))
+            for v in mutv:
+                c.update(writers.get(v, ()))
+                c.update(readers.get(v, ()))
+            conf.append(tuple(sorted(c)))
+            for v in const:
+                readers.setdefault(v, []).append(i)
+            for v in mutv:
+                writers.setdefault(v, []).append(i)
+                readers[v] = []
+        return conf
+
+    def on_replay_child(self, seq, i, ops, conf, events):
+        for j in conf[i]:
+            ev = events[j]
+            if ev is None or ev.is_set():
+                continue  # sync child (done in program order) or completed
+            sig_i, sig_j = ops[i][0], ops[j][0]
+            shared = sorted(
+                ({int(v) for v in sig_i[3]} | {int(v) for v in sig_i[4]})
+                & ({int(v) for v in sig_j[3]} | {int(v) for v in sig_j[4]}))
+            self._emit(self._mk(
+                "replay-edge-violation", shared[0] if shared else -1,
+                sig_i[1], "%s[%d]" % (seq, i), sig_j[1], "%s[%d]" % (seq, j),
+                detail="replay child %d starts before conflicting async "
+                       "child %d completed (shared vars %r): pre-resolved "
+                       "edges do not dominate the access set" % (i, j,
+                                                                 shared)))
+
+    # -- reporting -----------------------------------------------------------
+    @staticmethod
+    def _mk(rule, var, op, site, other_op, other_site, detail=""):
+        return {"rule": rule, "var": int(var), "op": op, "site": site,
+                "other_op": other_op, "other_site": other_site,
+                "detail": detail,
+                "stack": "".join(traceback.format_stack(limit=8)[:-2])}
+
+    def _emit(self, rep):
+        with _san_lock:
+            if len(self.reports) < self.MAX_REPORTS:
+                self.reports.append(rep)
+        # counter/log have their own locking: keep them OUTSIDE _san_lock
+        _san_counter.inc()
+        _log.error(
+            "engine sanitizer [%s] var %d: op '%s' at %s vs op '%s' at %s"
+            " — %s", rep["rule"], rep["var"], rep["op"], rep["site"],
+            rep["other_op"], rep["other_site"], rep["detail"])
+
+
+_san_counter = _telemetry.registry.counter(
+    "engine_sanitizer_reports_total",
+    help="Races reported by the engine happens-before sanitizer")
+
+
+def sanitizer_enabled() -> bool:
+    return _san is not None
+
+
+def sanitizer_enable(on: bool = True):
+    """Turn the happens-before sanitizer on (fresh shadow state) or off at
+    runtime; the import-time switch is MXNET_ENGINE_SANITIZER=1."""
+    global _san
+    _san = _Sanitizer() if on else None
+
+
+def sanitizer_reports() -> List[dict]:
+    """Snapshot of race reports since the sanitizer was (re-)enabled."""
+    if _san is None:
+        return []
+    with _san_lock:
+        return list(_san.reports)
+
+
+def sanitizer_clear():
+    """Drop accumulated reports; shadow epochs and guards are kept."""
+    if _san is not None:
+        with _san_lock:
+            del _san.reports[:]
+
+
+def guard_state(obj, var, name: Optional[str] = None):
+    """Register ``obj`` (host container/buffer) as engine state ordered by
+    ``var``: any pushed fn that can reach ``obj`` without declaring ``var``
+    races every unsettled access. No-op while the sanitizer is off."""
+    if _san is not None:
+        _san.guard(obj, var, name or type(obj).__name__)
+    return obj
+
+
+def unguard_state(obj):
+    if _san is not None:
+        _san.unguard(obj)
+
+
+if os.environ.get("MXNET_ENGINE_SANITIZER", "0").strip().lower() \
+        not in ("", "0", "false", "off"):
+    _san = _Sanitizer()
 
 
 # --- per-var in-flight accounting --------------------------------------------

@@ -1,0 +1,53 @@
+"""Known-bad fixture: pushed ops touching shared host state with no
+shared declared var (racecheck/undeclared-var-access).
+
+Parsed by the analyzer's self-check; NEVER imported. ``owner_site``
+establishes that ``results`` is engine-managed state ordered by
+``res_var``; the three bad sites touch the same container while
+declaring an unrelated var — directly, through a helper one call level
+deep, and through a container alias — so the engine cannot order them
+against the owner (or against ``clean_shared_var``). Each bad site is
+reported once per earlier conflicting site. ``clean_shared_var`` shows
+the correct shape: the second writer declares the same var, so the
+owner/clean pair itself is never flagged.
+"""
+from mxnet_tpu import engine
+
+results = []
+
+
+def owner_site():
+    res_var = engine.new_variable()
+    engine.push(lambda: results.append(1), mutable_vars=[res_var],
+                name="owner")
+    return res_var
+
+
+def clean_shared_var(res_var):
+    # OK vs the owner: ordered against it by the shared var
+    engine.push(lambda: results.append(5), mutable_vars=[res_var],
+                name="second_owner")
+
+
+def bad_direct():
+    other = engine.new_variable()
+    # BAD: writes `results` but declares only `other`
+    engine.push(lambda: results.append(2), mutable_vars=[other],
+                name="intruder")
+
+
+def bad_interprocedural():
+    other = engine.new_variable()
+
+    def helper():
+        results.append(3)
+
+    # BAD: the write is one call level deep inside `helper`
+    engine.push(lambda: helper(), mutable_vars=[other], name="deep")
+
+
+def bad_alias():
+    other = engine.new_variable()
+    alias = results
+    # BAD: same container through an alias, still no shared var
+    engine.push(lambda: alias.append(4), const_vars=[other], name="alias")

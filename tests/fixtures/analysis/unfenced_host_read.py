@@ -1,0 +1,43 @@
+"""Known-bad fixture: host reads of pushed state with no fence between
+(racecheck/unfenced-host-read).
+
+Parsed by the analyzer's self-check; NEVER imported. ``bad_read`` reads
+``self.queue`` right after pushing an op that appends to it — the op may
+not have run yet (or may run concurrently with the read). The clean
+variants interpose ``engine.fence(vars).wait()``, directly or through a
+helper (``_drain``), which the checker must resolve interprocedurally.
+"""
+from mxnet_tpu import engine
+
+
+class Stats:
+    def __init__(self):
+        self._var = engine.new_variable()
+        self.queue = []
+
+    def _emit(self):
+        engine.push(lambda: self.queue.append(2),
+                    mutable_vars=[self._var], name="stat2")
+
+    def _drain(self):
+        engine.fence([self._var], name="stats_drain").wait()
+
+    def bad_read(self):
+        engine.push(lambda: self.queue.append(1),
+                    mutable_vars=[self._var], name="stat")
+        return len(self.queue)  # BAD: no fence between push and read
+
+    def bad_read_interproc(self):
+        self._emit()            # may-push: writes self.queue
+        return list(self.queue)  # BAD: still no fence
+
+    def clean_read(self):
+        engine.push(lambda: self.queue.append(1),
+                    mutable_vars=[self._var], name="stat")
+        engine.fence([self._var], name="stats_drain").wait()
+        return len(self.queue)  # OK: fenced
+
+    def clean_read_interproc(self):
+        self._emit()
+        self._drain()           # may-sync: fences inside
+        return list(self.queue)  # OK

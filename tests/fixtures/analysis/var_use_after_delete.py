@@ -1,0 +1,30 @@
+"""Known-bad fixture: engine var named in push/fence lists after
+``delete_variable`` (racecheck/var-use-after-delete).
+
+Parsed by the analyzer's self-check; NEVER imported. Once deleted, the
+engine has dropped the var's dependency record — a later push or fence
+naming it orders against nothing (and on the native engine the id may
+be gone entirely). ``clean_recreate`` shows the reset shape: rebinding
+the name to a fresh var in between is fine.
+"""
+from mxnet_tpu import engine
+
+
+def bad_push_after_delete():
+    v = engine.new_variable()
+    engine.push(lambda: None, const_vars=[v], name="setup")
+    engine.delete_variable(v)
+    engine.push(lambda: None, mutable_vars=[v], name="late")  # BAD
+
+
+def bad_fence_after_delete():
+    v = engine.new_variable()
+    engine.delete_variable(v)
+    engine.fence([v], name="late_fence").wait()  # BAD
+
+
+def clean_recreate():
+    v = engine.new_variable()
+    engine.delete_variable(v)
+    v = engine.new_variable()  # rebound: fresh var, fresh record
+    engine.push(lambda: None, const_vars=[v], name="ok")
