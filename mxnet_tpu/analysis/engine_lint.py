@@ -33,6 +33,15 @@ other pushed op. Rules:
                                    never stabilizes (or replay-bails
                                    every step); snapshot with
                                    ``tuple(...)`` before pushing
+- ``fuse-ineligible-op``           in a module that consumes
+                                   ``MXNET_ENGINE_FUSE`` (references
+                                   ``fuse_enabled``/the env var), a
+                                   capture-region push carries no
+                                   ``fuse=`` metadata — one such op marks
+                                   the whole sequence fuse-ineligible and
+                                   it silently stays on replay; pass
+                                   ``fuse=engine.FuseOp(...)`` or an
+                                   explicit ``fuse=None`` to opt out
 
 Only *engine* pushes are matched (``push_async`` anywhere; ``push`` only
 via an engine module alias / ``self._engine`` / an import from engine) so
@@ -214,14 +223,31 @@ def _closure_mutations(fn: ast.AST) -> List[Tuple[str, int]]:
             if n not in params and n not in local and n != "self"]
 
 
+def _module_consumes_fuse(tree: ast.AST) -> bool:
+    """True when the module opts captured sequences into trace-and-fuse:
+    it references ``fuse_enabled`` (the engine gate) or spells the
+    ``MXNET_ENGINE_FUSE`` env var itself."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id == "fuse_enabled":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "fuse_enabled":
+            return True
+        if isinstance(node, ast.Constant) and \
+                node.value == "MXNET_ENGINE_FUSE":
+            return True
+    return False
+
+
 class _FnLint:
     def __init__(self, mod: SourceModule, aliases: Dict[str, str],
-                 qualname: str, fn: ast.AST, findings: List[Finding]):
+                 qualname: str, fn: ast.AST, findings: List[Finding],
+                 fuse_consumer: bool = False):
         self.mod = mod
         self.aliases = aliases
         self.qualname = qualname
         self.fn = fn
         self.findings = findings
+        self.fuse_consumer = fuse_consumer
         # local defs/lambdas by name, for resolving the pushed closure
         self.local_fns: Dict[str, ast.AST] = {}
         for node in ast.walk(fn):
@@ -271,6 +297,17 @@ class _FnLint:
                 recv.attr if isinstance(recv, ast.Attribute) else None)
             if recv_name not in seqs:
                 continue
+            if self.fuse_consumer and not any(
+                    kw.arg == "fuse" for kw in node.keywords):
+                self.findings.append(Finding(
+                    "engine", "fuse-ineligible-op", self.mod.relpath,
+                    node.lineno, self.qualname,
+                    "%s.%s" % (recv_name, f.attr),
+                    "capture-region push in a MXNET_ENGINE_FUSE consumer "
+                    "carries no traceable metadata — one such op marks "
+                    "the whole sequence fuse-ineligible and it silently "
+                    "stays on replay; pass fuse=engine.FuseOp(...) or an "
+                    "explicit fuse=None to opt this op out"))
             if muts is None:
                 muts = _container_mutations(self.fn)
             for nm in sorted(_bare_list_names(node)):
@@ -360,6 +397,7 @@ def check(modules: Sequence[SourceModule]) -> List[Finding]:
     findings: List[Finding] = []
     for m in modules:
         aliases = import_aliases(m.tree)
+        fuse_mod = _module_consumes_fuse(m.tree)
         # module-level statements + every def (methods get Class.method)
         _FnLint(m, aliases, "%s:" % m.modname,
                 ast.Module(body=[s for s in m.tree.body
@@ -367,11 +405,11 @@ def check(modules: Sequence[SourceModule]) -> List[Finding]:
                                                        ast.AsyncFunctionDef,
                                                        ast.ClassDef))],
                            type_ignores=[]),
-                findings).run()
+                findings, fuse_mod).run()
         for node in m.tree.body:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 _FnLint(m, aliases, "%s:%s" % (m.modname, node.name),
-                        node, findings).run()
+                        node, findings, fuse_mod).run()
             elif isinstance(node, ast.ClassDef):
                 for sub in node.body:
                     if isinstance(sub, (ast.FunctionDef,
@@ -379,5 +417,5 @@ def check(modules: Sequence[SourceModule]) -> List[Finding]:
                         _FnLint(m, aliases,
                                 "%s:%s.%s" % (m.modname, node.name,
                                               sub.name),
-                                sub, findings).run()
+                                sub, findings, fuse_mod).run()
     return findings

@@ -416,7 +416,8 @@ class Executor:
                         exe = _progcache.load(key)
                         if exe is None:
                             exe = lowered.compile()
-                            _progcache.store(key, exe, note="train_step")
+                            _progcache.store(key, exe, note="train_step",
+                                             kind="train_step")
                         aot["exec"] = exe
                     except Exception:
                         logging.getLogger("mxnet_tpu").warning(
@@ -454,6 +455,14 @@ class Executor:
                                  chain=chain, sharded=bool(sharded)):
                 return _run_impl(params, states, data_values, *extra)
 
+        # trace-and-fuse metadata (engine.FuseOp): the pure `step` plus the
+        # facts a consumer needs to stage it into a fused CapturedSequence.
+        # AUTO-layout and ZeRO-1 paths keep their own compiled artifacts
+        # (learned formats / sharded placement) that a re-trace inside a
+        # fused program would not reproduce, so they are fuse-ineligible.
+        run.fuse = {"step": step, "data_names": data_names,
+                    "executor": self, "use_auto": use_auto,
+                    "sharded": bool(sharded)}
         return run
 
     def _next_rng(self):
@@ -643,16 +652,19 @@ class CapturedTrainStep:
         self.step_var: Optional[int] = engine.new_variable()
         self.seq = engine.CapturedSequence(name=name)
 
-    def step(self, load_fn, step_fn):
+    def step(self, load_fn, step_fn, fuse_load=None, fuse_step=None):
         """Run one iteration through the capture state machine: eager
         during warmup, one replayed submission once the sequence is
-        stable."""
+        stable. ``fuse_load``/``fuse_step`` carry the ops' traceable
+        metadata (engine.FuseOp) so a stable sequence can lower into ONE
+        fused XLA program under MXNET_ENGINE_FUSE; None keeps replay."""
         seq = self.seq
         seq.begin_step()
         seq.push(load_fn, mutable_vars=(self.data_var,),
-                 name="fit.load_data")
+                 name="fit.load_data", fuse=fuse_load)
         seq.push(step_fn, const_vars=(self.data_var,),
-                 mutable_vars=(self.step_var,), name="fit.step")
+                 mutable_vars=(self.step_var,), name="fit.step",
+                 fuse=fuse_step)
         seq.end_step()
 
     def invalidate(self, reason: str):

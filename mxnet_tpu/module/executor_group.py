@@ -157,9 +157,15 @@ class DataParallelExecutorGroup:
         return self._exec.outputs
 
     def _put(self, target: NDArray, value):
+        target._data = self._place(target, value)
+
+    def _place(self, target: NDArray, value):
         # Keep device arrays on device: an NDArray batch feeds straight into
         # device_put (device-to-device, often a no-op) — no host round-trip.
         # The reference's H2D copy is likewise engine-async (SURVEY §3.5).
+        # Split from _put so trace-and-fuse feeds place a batch EXACTLY as
+        # _load_data would (same cast, same sharding) without touching the
+        # exec buffers.
         tgt_dtype = target._data.dtype
         if isinstance(value, NDArray):
             arr = value._data
@@ -171,16 +177,14 @@ class DataParallelExecutorGroup:
             dev = self.contexts[0].jax_device()
             if isinstance(arr, jax.Array) and not arr.is_deleted() \
                     and arr.sharding.device_set == {dev}:
-                target._data = arr  # already resident: no transfer
-            else:
-                target._data = jax.device_put(arr, dev)
-        else:
-            sharding = (
-                self._data_sharding
-                if arr.shape and arr.shape[0] % len(self.contexts) == 0
-                else self._repl_sharding
-            )
-            target._data = jax.device_put(arr, sharding)
+                return arr  # already resident: no transfer
+            return jax.device_put(arr, dev)
+        sharding = (
+            self._data_sharding
+            if arr.shape and arr.shape[0] % len(self.contexts) == 0
+            else self._repl_sharding
+        )
+        return jax.device_put(arr, sharding)
 
     def _load_data(self, data_batch):
         for name, val in zip(self.data_names, data_batch.data):

@@ -597,7 +597,9 @@ class Module(BaseModule):
                 _, fs["params"], fs["states"] = fs["step"](
                     fs["params"], fs["states"], {}, _lr, _wd)
 
-            cap.step(load, stepped)
+            f_load, f_step = self._fit_fuse_ops(fs, cap, data_batch,
+                                                lr_arr, wd_arr)
+            cap.step(load, stepped, fuse_load=f_load, fuse_step=f_step)
         else:
             # place the batch with the group's device/sharding logic; the
             # step then reads the executor's data buffers (empty feed dict).
@@ -631,6 +633,87 @@ class Module(BaseModule):
             cap.fence()  # old-geometry steps complete before the new load
         fs["capture_shapes"] = shapes
         return cap
+
+    def _fit_fuse_ops(self, fs, cap, data_batch, lr_arr, wd_arr):
+        """(fuse_load, fuse_step) FuseOp pair lowering the captured
+        fit_step into ONE fused XLA program (MXNET_ENGINE_FUSE;
+        engine.FusedSequence), or (None, None) when this setup can't be
+        traced faithfully. The step register carried across iterations on
+        ``cap.step_var`` is ``(params, states, aux, outs)``; its writeback
+        keeps ``fs``/aux_dict/outputs in sync each iteration so a bail's
+        replay closures resume from exactly the published state. The
+        AUTO-layout and ZeRO-1 paths own compiled artifacts (learned
+        formats, sharded placement) a plain re-trace would not reproduce,
+        so they stay on replay."""
+        from .. import engine
+        if not engine.fuse_enabled():
+            return None, None
+        meta = getattr(fs["step"], "fuse", None)
+        if meta is None or meta["use_auto"] or meta["sharded"]:
+            return None, None
+        exec_ = meta["executor"]
+        exec_group = self._exec_group
+        dvar, svar = cap.data_var, cap.step_var
+        pairs = [(n, i, False) for i, n in enumerate(exec_group.data_names)
+                 if n in exec_.arg_dict]
+        if exec_group.label_names and data_batch.label:
+            pairs += [(n, i, True)
+                      for i, n in enumerate(exec_group.label_names)
+                      if n in exec_.arg_dict]
+        # feed names the step reads but the batch never writes come from
+        # the exec buffers, exactly like _run_impl's arg_dict fill-in
+        batch_names = {n for n, _i, _l in pairs}
+        extra_names = tuple(n for n in meta["data_names"]
+                            if n not in batch_names and n in exec_.arg_dict)
+        feed_names = tuple(n for n, _i, _l in pairs) + extra_names
+
+        def load_feed(_db=data_batch):
+            # placed on the engine worker with _load_data's exact
+            # cast/sharding so fused and eager batches are bit-identical
+            vals = [exec_group._place(exec_.arg_dict[n],
+                                      (_db.label if is_l else _db.data)[i])
+                    for n, i, is_l in pairs]
+            vals += [exec_.arg_dict[n]._data for n in extra_names]
+            return tuple(vals)
+
+        def load_jax(*vals, _names=feed_names):
+            return ({n: v for n, v in zip(_names, vals)},)
+
+        fuse_load = engine.FuseOp(
+            load_jax, out_vars=(dvar,), feed=load_feed,
+            fingerprint="fit.load_data:v1:%r" % (feed_names,))
+
+        step_pure = meta["step"]
+
+        def step_feed(_lr=lr_arr, _wd=wd_arr):
+            return (exec_._next_rng(), _lr, _wd)
+
+        def step_jax(data_reg, step_reg, rng, lr, wd):
+            params, states, aux, _outs = step_reg
+            outs, new_p, new_s, aux_up = step_pure(params, states, aux,
+                                                   rng, data_reg, lr, wd)
+            na = dict(aux)
+            na.update(aux_up)
+            return ((new_p, new_s, na, tuple(outs)),)
+
+        def step_init():
+            return (fs["params"], fs["states"],
+                    {n: a._data for n, a in exec_.aux_dict.items()},
+                    tuple(o._data for o in exec_.outputs))
+
+        def step_writeback(d, _svar=svar):
+            new_p, new_s, na, outs = d[_svar]
+            fs["params"], fs["states"] = new_p, new_s
+            for n, v in na.items():
+                if n in exec_.aux_dict:
+                    exec_.aux_dict[n]._data = v
+            exec_.outputs = [nd.NDArray(o) for o in outs]
+
+        fuse_step = engine.FuseOp(
+            step_jax, in_vars=(dvar, svar), out_vars=(svar,),
+            feed=step_feed, init={svar: step_init},
+            writeback=step_writeback)
+        return fuse_load, fuse_step
 
     def _capture_fence(self):
         """Happens-before for readers of fused-step results when engine

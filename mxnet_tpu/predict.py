@@ -162,7 +162,8 @@ class Predictor:
         _COMPILE_COUNT += 1
         self.progcache_source = "compile"
         if cache_key is not None:
-            progcache.store(cache_key, self._exec, note="predictor")
+            progcache.store(cache_key, self._exec, note="predictor",
+                            kind="predictor")
 
     def _device_scope(self):
         import contextlib

@@ -88,6 +88,12 @@ _stats = {"hits": 0, "misses": 0, "fallbacks": 0, "stores": 0,
 # the progcache_bytes gauge reads this instead of hitting the disk.
 _bytes_by_dir: Dict[str, int] = {}
 
+# Same, split by entry kind (predictor / train_step / fused / "" for
+# legacy entries) — a per-kind gauge is registered lazily when a kind
+# first appears so the exposition only grows for kinds actually in use.
+_bytes_by_dir_kind: Dict[str, Dict[str, int]] = {}
+_kind_gauges: Dict[str, object] = {}
+
 _hits = _telemetry.registry.counter(
     "progcache_hits", "persistent program cache: successful disk loads")
 _misses = _telemetry.registry.counter(
@@ -230,11 +236,44 @@ def lowered_key(lowered_text: str, donate: Sequence[int] = (),
     return h.hexdigest()
 
 
+def fused_key(capture_sig: str, lowered_text: Optional[str] = None) -> str:
+    """Cache key for a trace-and-fused CapturedSequence (engine
+    ``FusedSequence``): sha1 over the capture signature — per-op
+    fingerprints, the resolved edge set and in/out avals, already
+    normalized to process-independent var indices — plus the lowered
+    StableHLO text when any op had no explicit fingerprint, plus the
+    runtime facts. Warm restarts of the same captured program re-derive
+    the same key and disk-load with zero fresh compiles."""
+    h = hashlib.sha1()
+    h.update(b"fused\x00")
+    h.update(capture_sig.encode())
+    if lowered_text is not None:
+        h.update(b"\x00text\x00")
+        h.update(lowered_text.encode())
+    h.update(json.dumps(_runtime_meta(), sort_keys=True).encode())
+    return h.hexdigest()
+
+
 # --- manifest -------------------------------------------------------------
 
 def _entries_crc(entries: Dict, ladders: Dict, clock: int) -> int:
     blob = json.dumps([entries, ladders, clock], sort_keys=True).encode()
     return binascii.crc32(blob) & 0xFFFFFFFF
+
+
+def _entry_kind(path: str) -> str:
+    """The ``kind`` from an entry file's meta header (manifest rebuild
+    only reads the small header, never the payload)."""
+    try:
+        with open(path, "rb") as f:
+            head = f.read(len(MAGIC) + 4)
+            if not head.startswith(MAGIC):
+                return ""
+            (mlen,) = _U32.unpack_from(head, len(MAGIC))
+            meta = json.loads(f.read(mlen).decode())
+        return str(meta.get("kind", ""))
+    except Exception:
+        return ""
 
 
 def _load_manifest(d: str) -> Dict:
@@ -268,9 +307,29 @@ def _load_manifest(d: str) -> Dict:
                 sz = os.path.getsize(os.path.join(d, fn))
             except OSError:
                 continue
-            entries[fn[:-len(".prog")]] = {"bytes": sz, "clock": 0}
+            e = {"bytes": sz, "clock": 0}
+            kind = _entry_kind(os.path.join(d, fn))
+            if kind:
+                e["kind"] = kind
+            entries[fn[:-len(".prog")]] = e
     return {"version": MANIFEST_VERSION, "clock": 0, "entries": entries,
             "ladders": {}, "crc": _entries_crc(entries, {}, 0)}
+
+
+def _refresh_kind_bytes(d: str, m: Dict):
+    by_kind: Dict[str, int] = {}
+    for e in m["entries"].values():
+        k = e.get("kind", "")
+        by_kind[k] = by_kind.get(k, 0) + e.get("bytes", 0)
+    _bytes_by_dir_kind[d] = by_kind
+    for k in by_kind:
+        if k and k not in _kind_gauges:
+            _kind_gauges[k] = _telemetry.registry.gauge(
+                "progcache_bytes_kind_" + k,
+                lambda _k=k: float(sum(
+                    bk.get(_k, 0) for bk in _bytes_by_dir_kind.values())),
+                "persistent program cache: bytes on disk for %r entries"
+                % k)
 
 
 def _commit_manifest(d: str, m: Dict):
@@ -278,6 +337,7 @@ def _commit_manifest(d: str, m: Dict):
     _atomic_write_bytes(os.path.join(d, MANIFEST),
                         json.dumps(m, sort_keys=True).encode())
     _bytes_by_dir[d] = sum(e.get("bytes", 0) for e in m["entries"].values())
+    _refresh_kind_bytes(d, m)
 
 
 def _evict_over_budget(d: str, m: Dict, protect: str) -> List[str]:
@@ -412,11 +472,13 @@ def load(key: str):
     return exe
 
 
-def store(key: str, compiled, note: str = "") -> bool:
+def store(key: str, compiled, note: str = "", kind: str = "") -> bool:
     """Serialize ``compiled`` and commit it under ``key`` atomically,
-    then update the manifest and evict past the byte budget. Best-effort:
-    returns False (never raises) when serialization or I/O fails — the
-    caller already has its compiled program either way."""
+    then update the manifest and evict past the byte budget. ``kind``
+    classifies the entry (``predictor`` / ``train_step`` / ``fused`` /
+    ``decode``) for the per-kind byte accounting. Best-effort: returns
+    False (never raises) when serialization or I/O fails — the caller
+    already has its compiled program either way."""
     d = cache_dir()
     if d is None:
         return False
@@ -432,6 +494,8 @@ def store(key: str, compiled, note: str = "") -> bool:
             meta["key"] = key
             if note:
                 meta["note"] = note
+            if kind:
+                meta["kind"] = kind
             blob = _pack_entry(meta, payload)
             os.makedirs(d, exist_ok=True)
             _atomic_write_bytes(_entry_path(d, key), blob)
@@ -442,7 +506,10 @@ def store(key: str, compiled, note: str = "") -> bool:
         with _lock:
             m = _load_manifest(d)
             m["clock"] += 1
-            m["entries"][key] = {"bytes": len(blob), "clock": m["clock"]}
+            entry = {"bytes": len(blob), "clock": m["clock"]}
+            if kind:
+                entry["kind"] = kind
+            m["entries"][key] = entry
             victims = _evict_over_budget(d, m, protect=key)
             try:
                 _commit_manifest(d, m)
@@ -529,4 +596,17 @@ def bytes_in_use() -> int:
         m = _load_manifest(d)
         total = sum(e.get("bytes", 0) for e in m["entries"].values())
         _bytes_by_dir[d] = total
+        _refresh_kind_bytes(d, m)
     return total
+
+
+def bytes_by_kind() -> Dict[str, int]:
+    """Bytes on disk in the active cache dir split by entry ``kind``
+    (``""`` collects entries stored before kinds existed)."""
+    d = cache_dir()
+    if d is None:
+        return {}
+    with _lock:
+        m = _load_manifest(d)
+        _refresh_kind_bytes(d, m)
+        return dict(_bytes_by_dir_kind[d])

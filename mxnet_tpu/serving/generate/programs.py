@@ -67,7 +67,7 @@ class _Compiled:
             self.source = "compile"
             counters.compiles += 1
             if key is not None:
-                _progcache.store(key, self._exec, note=note)
+                _progcache.store(key, self._exec, note=note, kind="decode")
         except Exception:
             # anything going sideways in lowering/AOT pins the plain-jit
             # path; its first call is still one fresh compile

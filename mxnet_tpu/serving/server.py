@@ -31,6 +31,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+import jax.numpy as jnp
+
 from .. import engine
 from .. import predict as predict_mod
 from .. import progcache as _progcache
@@ -94,6 +96,11 @@ class ServingConfig:
     #: one CapturedSequence per (replica, nominal bucket), invalidated by
     #: adaptive ladder swaps (engine.CapturedSequence, docs/perf.md)
     capture: bool = field(default_factory=lambda: engine.capture_enabled())
+    #: trace-and-fuse the captured dispatch (MXNET_ENGINE_FUSE; requires
+    #: ``capture``): a stable per-(replica, bucket) sequence lowers into
+    #: ONE fused XLA program, bailing back to replay when acquire()
+    #: resolves a different bucket/program than the staged one
+    fuse: bool = field(default_factory=lambda: engine.fuse_enabled())
 
 
 class _Replica:
@@ -134,6 +141,7 @@ class InferenceServer:
         self._example_shapes = {n: tuple(s)
                                 for n, s in example_shapes.items()}
         self._input_names = list(self._example_shapes)
+        self._dtype = dtype
         self._batch_end_callback = batch_end_callback
         symbol_json = symbol.tojson() if hasattr(symbol, "tojson") else symbol
 
@@ -453,7 +461,7 @@ class InferenceServer:
             dispatch = (lambda done, batch=batch, rep=rep, nbatch=nbatch:
                         self._dispatch(batch, rep, nbatch, done))
             if self.config.capture:
-                self._push_captured(rep, batch, dispatch)
+                self._push_captured(rep, batch, dispatch, nbatch)
             else:
                 engine.push_async(
                     dispatch, mutable_vars=[rep.var],
@@ -463,7 +471,7 @@ class InferenceServer:
                 self._push_retune()
 
     def _push_captured(self, rep: _Replica, batch: List[Request],
-                       dispatch: Callable):
+                       dispatch: Callable, nbatch: int):
         """Dispatch through the replica's per-bucket CapturedSequence
         (ServingConfig.capture). The NOMINAL bucket — smallest current
         ladder rung holding the batch — keys the sequence, so each
@@ -478,12 +486,87 @@ class InferenceServer:
         cs = rep.captures.get(bucket)
         if cs is None:
             cs = engine.CapturedSequence(
-                name="serving_r%d_b%d" % (rep.index, bucket))
+                name="serving_r%d_b%d" % (rep.index, bucket),
+                fuse=True if self.config.fuse else None)
             rep.captures[bucket] = cs
+        fuse = (self._fuse_dispatch_op(rep, bucket, batch, nbatch)
+                if self.config.fuse else None)
         cs.begin_step()
         cs.push_async(dispatch, mutable_vars=(rep.var,),
-                      name="serving_dispatch_r%d" % rep.index)
+                      name="serving_dispatch_r%d" % rep.index, fuse=fuse)
         cs.end_step()
+
+    def _fuse_dispatch_op(self, rep: _Replica, bucket: int,
+                          batch: List[Request], nbatch: int):
+        """Traceable metadata for one captured dispatch (ServingConfig.fuse;
+        engine.FuseOp): the nominal bucket predictor's jitted forward is
+        the staged computation, the per-iteration feed re-runs the atomic
+        ``acquire()`` + pad on the engine worker and bails to replay when
+        it resolves a different bucket or program than the staged one, and
+        the writeback publishes results exactly like ``_dispatch``'s
+        post-forward tail. None when the executor exposes no traceable
+        forward (keeps the sequence on replay)."""
+        exe = rep.cache.prepare(bucket)
+        jitted = getattr(exe, "_jitted", None)
+        if jitted is None:
+            return None
+        names = self._input_names
+        dtype = jnp.dtype(getattr(exe, "_dtype", self._dtype))
+        fp = getattr(exe, "_progcache_model_fp", None)
+
+        def fwd_fn(*vals, _jit=jitted):
+            return (tuple(_jit(*vals)),)
+
+        def feed(_batch=batch, _exe=exe, _bucket=bucket):
+            # any failure here happened BEFORE any result was published:
+            # converting it to a bail makes the whole iteration replay
+            # through _dispatch, whose handler owns request error delivery
+            try:
+                rows = sum(r.rows for r in _batch)
+                b, got = rep.cache.acquire(rows)
+                if got is not _exe:
+                    raise engine._FuseBail(
+                        "bucket drift: acquire() resolved b%d, staged b%d"
+                        % (b, _bucket))
+                if self.config.zero_copy:
+                    fd = rep.staging.fill(_batch, b, names)
+                else:
+                    fd = {}
+                    for name in names:
+                        cat = np.concatenate(
+                            [r.inputs[name] for r in _batch], axis=0)
+                        if b > rows:
+                            pad = np.zeros(
+                                (b - rows,) + cat.shape[1:], cat.dtype)
+                            cat = np.concatenate([cat, pad], axis=0)
+                        fd[name] = cat
+                return tuple(jnp.asarray(fd[n]).astype(dtype)
+                             for n in names)
+            except engine._FuseBail:
+                raise
+            except BaseException as e:
+                raise engine._FuseBail("dispatch feed failed: %s: %s"
+                                       % (type(e).__name__, e))
+
+        def writeback(d, _batch=batch, _nbatch=nbatch, _bucket=bucket):
+            outs = d[rep.var]
+            try:
+                self._publish_outputs(_batch, rep, _nbatch, _bucket,
+                                      sum(r.rows for r in _batch), outs)
+            except BaseException as e:  # mirror _dispatch's error contract
+                err = e if isinstance(e, ServingError) else ServingError(
+                    "dispatch failed: %s: %s" % (type(e).__name__, e),
+                    "dispatch_error")
+                self.metrics.record_error(err.code)
+                for r in _batch:
+                    if not r.done():
+                        r.set_error(err)
+
+        return engine.FuseOp(
+            fwd_fn, out_vars=(rep.var,), feed=feed, writeback=writeback,
+            fingerprint=(None if fp is None
+                         else "serving:%s:b%d:%s:%r" % (fp, bucket,
+                                                        dtype, names)))
 
     def _pick_replica(self) -> _Replica:
         """Routing policy. ``rr``: classic round-robin. ``least_loaded``:
@@ -607,32 +690,7 @@ class InferenceServer:
             with telemetry.span("serving.forward", domain="serving",
                                 bucket=bucket):
                 outs = [o.asnumpy() for o in exe.forward(**feed)]
-            for o in outs:
-                if o.shape[:1] != (bucket,):
-                    raise ServingError(
-                        "output batch axis %s != bucket %d — serving "
-                        "requires batch-major outputs" % (o.shape, bucket))
-            offset = 0
-            lats = []
-            for r in batch:
-                r.set_result([o[offset:offset + r.rows] for o in outs])
-                offset += r.rows
-                lats.append(r.latency_ms)
-            rep.dispatched += 1
-            self.metrics.record_batch(rows, bucket, lats)
-            if self._batch_end_callback is not None:
-                # every request already completed: a raising user callback
-                # must not be recorded as a dispatch failure
-                try:
-                    self._batch_end_callback(ServingBatchEndParam(
-                        nbatch=nbatch, bucket=bucket, rows=rows,
-                        replica=rep.index,
-                        latency_ms=sum(lats) / len(lats), occupancy=rows,
-                        metrics=self.metrics))
-                except Exception:
-                    logging.getLogger("mxnet_tpu").exception(
-                        "serving batch_end_callback raised (batch %d)",
-                        nbatch)
+            self._publish_outputs(batch, rep, nbatch, bucket, rows, outs)
         except BaseException as e:
             err = e if isinstance(e, ServingError) else ServingError(
                 "dispatch failed: %s: %s" % (type(e).__name__, e),
@@ -644,6 +702,40 @@ class InferenceServer:
         finally:
             sp.__exit__(None, None, None)
             on_complete()
+
+    def _publish_outputs(self, batch: List[Request], rep: _Replica,
+                         nbatch: int, bucket: int, rows: int, outs):
+        """Post-forward publication tail shared by ``_dispatch`` and the
+        fused writeback: batch-axis check, per-request result slicing,
+        metrics and the batch_end_callback. Raises on contract violations
+        — the caller owns request error delivery."""
+        outs = [np.asarray(o) for o in outs]
+        for o in outs:
+            if o.shape[:1] != (bucket,):
+                raise ServingError(
+                    "output batch axis %s != bucket %d — serving "
+                    "requires batch-major outputs" % (o.shape, bucket))
+        offset = 0
+        lats = []
+        for r in batch:
+            r.set_result([o[offset:offset + r.rows] for o in outs])
+            offset += r.rows
+            lats.append(r.latency_ms)
+        rep.dispatched += 1
+        self.metrics.record_batch(rows, bucket, lats)
+        if self._batch_end_callback is not None:
+            # every request already completed: a raising user callback
+            # must not be recorded as a dispatch failure
+            try:
+                self._batch_end_callback(ServingBatchEndParam(
+                    nbatch=nbatch, bucket=bucket, rows=rows,
+                    replica=rep.index,
+                    latency_ms=sum(lats) / len(lats), occupancy=rows,
+                    metrics=self.metrics))
+            except Exception:
+                logging.getLogger("mxnet_tpu").exception(
+                    "serving batch_end_callback raised (batch %d)",
+                    nbatch)
 
     # --- introspection ----------------------------------------------------
     def get_metrics(self):

@@ -1,0 +1,36 @@
+"""Known-bad fixture: fuse-ineligible-op.
+
+A module that consumes MXNET_ENGINE_FUSE (it gates on
+``engine.fuse_enabled()``) yet records a capture-region op WITHOUT
+``fuse=`` metadata.  One such op marks the whole sequence
+fuse-ineligible, so the "fused" mode silently degrades to replay — the
+exact failure trace-and-fuse bails are meant to make loud.
+Parsed, never imported.
+"""
+from mxnet_tpu import engine
+
+
+def fuse_blind_capture(batches):
+    seq = engine.CapturedSequence(name="fixture",
+                                  fuse=engine.fuse_enabled())
+    v = engine.new_variable()
+    for _ in batches:
+        seq.begin_step()
+        # BAD: no fuse= metadata in a fuse consumer — the sequence can
+        # never stage and silently stays on replay
+        seq.push(lambda: None, mutable_vars=(v,), name="op")
+        seq.end_step()
+
+
+def fuse_aware_capture(batches, op):
+    # clean shape: every recorded op carries metadata (or an explicit
+    # fuse=None opt-out) — no finding
+    seq = engine.CapturedSequence(name="fixture_ok",
+                                  fuse=engine.fuse_enabled())
+    v = engine.new_variable()
+    for _ in batches:
+        seq.begin_step()
+        seq.push(lambda: None, mutable_vars=(v,), name="op",
+                 fuse=engine.FuseOp(op, out_vars=(v,)))
+        seq.push(lambda: None, const_vars=(v,), name="log", fuse=None)
+        seq.end_step()

@@ -1,0 +1,290 @@
+"""Trace-and-fuse (MXNET_ENGINE_FUSE): a stable CapturedSequence lowers
+into ONE fused XLA program — registers thread engine vars through a
+donated carry, feeds re-evaluate per iteration, writebacks keep host
+state in sync, and ANY bail falls back to the replay path bit-for-bit
+(docs/perf.md trace-and-fuse section)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine, telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    telemetry.reset()
+    telemetry.disable_spans()
+    yield
+    telemetry.disable_spans()
+    telemetry.reset()
+
+
+def _braid(name, host, v, warmup=2, second_fuse=True):
+    """A 2-op (+1)*(2) chain over one var; returns the sequence and the
+    per-iteration driver. ``second_fuse=False`` drops the second op's
+    metadata — the whole sequence must then stay on replay."""
+    cs = engine.CapturedSequence(name=name, warmup=warmup, fuse=True)
+
+    def add():
+        host["x"] = host["x"] + 1.0
+
+    def mul():
+        host["x"] = host["x"] * 2.0
+
+    f_add = engine.FuseOp(lambda x: (x + 1.0,), in_vars=(v,), out_vars=(v,),
+                          init={v: lambda: host["x"]},
+                          fingerprint="t_fuse:add")
+    f_mul = engine.FuseOp(lambda x: (x * 2.0,), in_vars=(v,), out_vars=(v,),
+                          writeback=lambda d: host.__setitem__("x", d[v]),
+                          fingerprint="t_fuse:mul")
+
+    def one_iter():
+        cs.begin_step()
+        cs.push(add, mutable_vars=(v,), name="t_add", fuse=f_add)
+        cs.push(mul, mutable_vars=(v,), name="t_mul",
+                fuse=(f_mul if second_fuse else None))
+        cs.end_step()
+
+    return cs, one_iter
+
+
+def test_fused_sequence_matches_eager_reference():
+    v = engine.new_variable()
+    engine.track_inflight(v)
+    host = {"x": jnp.zeros((4,), jnp.float32)}
+    before = engine.fused_stats()
+    cs, one_iter = _braid("t_fuse_basic", host, v)
+    for _ in range(6):
+        one_iter()
+    engine.fence([v]).wait(30)
+    assert cs.state == "ready" and cs._fuse_state == "staged"
+    # 2 warmup iterations ran eagerly, the other 4 each as ONE fused push
+    assert cs.fused_runs == 4 and cs.fuse_bails == 0 and cs.replays == 0
+    assert engine.fused_stats()["runs"] - before["runs"] == 4
+    # the single-push submission drains through per-var accounting
+    assert engine.var_inflight(v) == 0
+    ref = np.zeros((4,), np.float32)
+    for _ in range(6):
+        ref = (ref + 1.0) * 2.0
+    assert np.array_equal(np.asarray(host["x"]), ref)
+    engine.untrack_inflight(v)
+    engine.delete_variable(v)
+
+
+def test_op_without_fuse_metadata_marks_sequence_ineligible():
+    """The acceptance bail path: one non-traceable op keeps the WHOLE
+    sequence on replay, values stay correct."""
+    v = engine.new_variable()
+    host = {"x": jnp.zeros((4,), jnp.float32)}
+    before = engine.fused_stats()
+    cs, one_iter = _braid("t_fuse_inel", host, v, second_fuse=False)
+    for _ in range(6):
+        one_iter()
+    engine.fence([v]).wait(30)
+    assert cs.state == "ready"
+    assert cs._fuse_state == "ineligible"
+    assert cs.fused_runs == 0 and cs.replays == 4
+    after = engine.fused_stats()
+    assert after["ineligible"] - before["ineligible"] == 1
+    assert after["bails"] - before["bails"] >= 1
+    ref = np.zeros((4,), np.float32)
+    for _ in range(6):
+        ref = (ref + 1.0) * 2.0
+    assert np.array_equal(np.asarray(host["x"]), ref)
+    engine.delete_variable(v)
+
+
+def test_feed_drift_bails_iteration_to_replay():
+    """A feed whose aval drifts mid-stream bails BEFORE any side effect;
+    that iteration (and later ones) replay the eager closures, so the
+    values never fork."""
+    v = engine.new_variable()
+    host = {"x": jnp.zeros((3,), jnp.float32)}
+    drift = {"on": False}
+
+    def feed():
+        return (jnp.asarray(1, jnp.int32 if drift["on"] else jnp.float32),)
+
+    def add():
+        host["x"] = host["x"] + feed()[0]
+
+    f_add = engine.FuseOp(lambda x, inc: (x + inc,), in_vars=(v,),
+                          out_vars=(v,), feed=feed,
+                          init={v: lambda: host["x"]},
+                          writeback=lambda d: host.__setitem__("x", d[v]),
+                          fingerprint="t_fuse:drift")
+    cs = engine.CapturedSequence(name="t_fuse_drift", warmup=2, fuse=True)
+    for it in range(8):
+        drift["on"] = it >= 5
+        cs.begin_step()
+        cs.push(add, mutable_vars=(v,), name="t_add", fuse=f_add)
+        cs.end_step()
+        # fence per iteration: the drift is detected on the engine worker,
+        # and the submit-side fused/replay choice must observe it before
+        # the next end_step for the counters to be deterministic
+        engine.fence([v]).wait(30)
+    # iterations 2-4 fused; 5 was submitted fused (counted), bailed on
+    # the drifted feed and replayed INLINE on the worker; a run bail is
+    # permanent (the carry may be stale), so 6-7 take the replay path
+    assert cs.fused_runs == 4 and cs.fuse_bails == 1
+    assert cs._fuse_state == "dead"
+    assert cs.replays == 2
+    # int32 1 and float32 1.0 add identically: the stream never forks
+    assert np.array_equal(np.asarray(host["x"]),
+                          np.full((3,), 8.0, np.float32))
+    engine.delete_variable(v)
+
+
+def test_fused_run_span_roundtrip_and_counters():
+    nv0 = dict(telemetry.registry.get_name_value())
+    telemetry.enable_spans("engine")
+    v = engine.new_variable()
+    host = {"x": jnp.zeros((2,), jnp.float32)}
+    cs, one_iter = _braid("t_fuse_tele", host, v)
+    for _ in range(5):
+        one_iter()
+    engine.fence([v]).wait(30)
+    assert cs.fused_runs == 3
+    evs = telemetry.drain_events()
+    fused = [e for e in evs if e[1] == "engine.fused_run"]
+    assert len(fused) == 3
+    for _ph, _name, domain, _ts, _dur, args, _tid, _tname in fused:
+        assert domain == "engine"
+        assert args["ops"] == 2 and args["sequence"] == "t_fuse_tele"
+        # the capture-signature prefix identifies the staged program
+        assert args["signature"] == cs._fused.signature[:12]
+    nv = dict(telemetry.registry.get_name_value())
+    assert nv["engine_fused_runs_total"] == \
+        nv0.get("engine_fused_runs_total", 0) + 3
+    assert nv["engine_fuse_bails_total"] == \
+        nv0.get("engine_fuse_bails_total", 0)
+    engine.delete_variable(v)
+
+
+def test_sanitizer_clean_then_flags_tampered_edges():
+    """The fused push validates that the declared edge set dominates every
+    conflict predecessor (the static analogue of replay's per-child
+    check): a clean braid reports nothing; stripping the recorded deps
+    must surface fused-edge-violation."""
+    was_on = engine.sanitizer_enabled()
+    engine.sanitizer_enable(True)
+    try:
+        v = engine.new_variable()
+        host = {"x": jnp.zeros((2,), jnp.float32)}
+        cs, one_iter = _braid("t_fuse_san", host, v)
+        for _ in range(5):
+            one_iter()
+        engine.fence([v]).wait(30)
+        assert cs._fuse_state == "staged" and cs.fused_runs == 3
+        assert [r for r in engine.sanitizer_reports()
+                if r["rule"] == "fused-edge-violation"] == []
+        # tamper: drop the recorded WAW edge between the two ops, then
+        # re-arm the sanitizer so the staged program re-validates
+        cs._ops = [(sig, ()) for sig, _ in cs._ops]
+        engine.sanitizer_enable(True)
+        one_iter()
+        engine.fence([v]).wait(30)
+        viol = [r for r in engine.sanitizer_reports()
+                if r["rule"] == "fused-edge-violation"]
+        assert viol and "t_fuse_san" in viol[0]["site"]
+        engine.delete_variable(v)
+    finally:
+        engine.sanitizer_enable(was_on)
+
+
+def test_fit_step_fused_bitwise_equals_eager(monkeypatch):
+    """End-to-end train-path equivalence: 7 identically-seeded steps,
+    eager vs captured+fused, weights bitwise identical."""
+    in_dim, steps = 12, 7
+
+    def build():
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+        sym = mx.sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(sym, data_names=("data",),
+                            label_names=("softmax_label",))
+        mod.bind(data_shapes=[("data", (4, in_dim))],
+                 label_shapes=[("softmax_label", (4,))])
+        r = np.random.RandomState(3)
+        args0 = {n: mx.nd.array(r.uniform(-0.1, 0.1, arr.shape)
+                                .astype(np.float32))
+                 for n, arr in mod._exec_group._exec.arg_dict.items()
+                 if n not in ("data", "softmax_label")}
+        mod.init_params(initializer=None, arg_params=args0)
+        mod.init_optimizer(
+            kvstore=None, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.05), ("momentum", 0.9)))
+        return mod
+
+    def batches():
+        r = np.random.RandomState(4)
+        return [mx.io.DataBatch(
+            data=[mx.nd.array(r.uniform(-1, 1, (4, in_dim))
+                              .astype(np.float32))],
+            label=[mx.nd.array(r.randint(0, 3, (4,)).astype(np.float32))])
+            for _ in range(steps)]
+
+    monkeypatch.delenv("MXNET_ENGINE_CAPTURE", raising=False)
+    monkeypatch.delenv("MXNET_ENGINE_FUSE", raising=False)
+    mod_e = build()
+    for bt in batches():
+        mod_e.fit_step(bt)
+    w_eager = {n: arr.asnumpy().copy()
+               for n, arr in mod_e.get_params()[0].items()}
+
+    monkeypatch.setenv("MXNET_ENGINE_CAPTURE", "1")
+    monkeypatch.setenv("MXNET_ENGINE_FUSE", "1")
+    mod_f = build()
+    for bt in batches():
+        mod_f.fit_step(bt)
+    seq = mod_f._fused_fit["capture"].seq
+    assert seq._fuse_state == "staged"
+    assert seq.fused_runs > 0 and seq.fuse_bails == 0
+    w_fused = {n: arr.asnumpy().copy()
+               for n, arr in mod_f.get_params()[0].items()}
+    for n in w_eager:
+        assert np.array_equal(w_eager[n], w_fused[n]), n
+
+
+def test_serving_fused_dispatch_matches_eager():
+    """ServingConfig.fuse: the per-(replica, bucket) dispatch runs as one
+    fused program in steady state and every response is identical to the
+    uncaptured server's."""
+    from mxnet_tpu import serving
+
+    in_dim = 10
+    data = mx.sym.Variable("data")
+    sym = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    rng = np.random.RandomState(0)
+    shapes, _, _ = sym.infer_shape(data=(1, in_dim))
+    params = {n: rng.uniform(-0.1, 0.1, s).astype(np.float32)
+              for n, s in zip(sym.list_arguments(), shapes) if n != "data"}
+
+    def run(capture, fuse):
+        cfg = serving.ServingConfig(buckets=(4,), max_delay_ms=0.5,
+                                    capture=capture, fuse=fuse)
+        srv = serving.InferenceServer(sym, params, {"data": (in_dim,)},
+                                      config=cfg).start()
+        outs, st = [], None
+        try:
+            r = np.random.RandomState(1)
+            for _ in range(10):
+                x = r.uniform(-1, 1, (2, in_dim)).astype(np.float32)
+                outs.append(np.asarray(
+                    srv.submit(data=x).get(timeout=30)[0]))
+            for rep in srv._replicas:
+                for cs in rep.captures.values():
+                    st = cs
+        finally:
+            srv.stop()
+        return outs, st
+
+    o_eager, _ = run(False, False)
+    o_fused, cs = run(True, True)
+    assert cs is not None and cs._fuse_state == "staged"
+    assert cs.fused_runs > 0 and cs.fuse_bails == 0
+    for a, b in zip(o_eager, o_fused):
+        assert np.array_equal(a, b)
