@@ -1137,39 +1137,16 @@ def run_progcache_config():
     }
 
 
-def run_decode_config():
-    """Continuous-batching decode A/B (BENCH_MODEL=decode): the same
-    generate workload (BENCH_DECODE_STREAMS prompts x BENCH_DECODE_NEW
-    greedy tokens on a tiny transformer LM) through arm A = the
-    DecodeScheduler (iteration-level batching over slot-allocated KV
-    slabs, one fixed-shape decode program) and arm B = the naive serving
-    baseline (one sequence at a time, FULL-context re-prefill for every
-    token — what serving autoregression costs without a KV cache). Both
-    arms share compiled programs built before timing; each repeat runs
-    the arms BACK-TO-BACK and value = median of the per-repeat paired
-    tokens/sec ratios (checkpoint-bench idiom: paired ratios, not
-    min-vs-min, or CPU drift swings the number more than the gate).
-    ISSUE 9 gate: >= 2x, so vs_baseline = value / 2.0."""
+def _decode_bench_model(v, d, n_layers, h, hkv, seed=3):
+    """Tiny transformer LM for the decode benches (shared by the
+    continuous-batching A/B and the paged-KV A/B so both arms of both
+    benches speak about the same model)."""
     import numpy as _np
 
-    from mxnet_tpu import telemetry
-    from mxnet_tpu.serving.generate import (DecodeModel, DecodePrograms,
-                                            DecodeScheduler, DecodeSpec,
-                                            GenerateConfig)
+    from mxnet_tpu.serving.generate import DecodeModel, DecodeSpec
 
-    v = int(os.environ.get("BENCH_DECODE_VOCAB", "64"))
-    d = int(os.environ.get("BENCH_DECODE_DIM", "32"))
-    n_layers = int(os.environ.get("BENCH_DECODE_LAYERS", "2"))
-    h, hkv = 4, 2
     f = 2 * d
-    n_streams = int(os.environ.get("BENCH_DECODE_STREAMS", "8"))
-    prompt_len = int(os.environ.get("BENCH_DECODE_PROMPT", "6"))
-    new_tokens = int(os.environ.get("BENCH_DECODE_NEW", "24"))
-    slots = int(os.environ.get("BENCH_DECODE_SLOTS", "4"))
-    repeats = max(1, int(os.environ.get("BENCH_DECODE_REPEATS", "5")))
-    max_context = prompt_len + new_tokens + 2
-
-    rng = _np.random.RandomState(3)
+    rng = _np.random.RandomState(seed)
     dkv = d // h * hkv
     params = {"embed_weight": (rng.randn(v, d) * 0.3).astype(_np.float32)}
     for i in range(n_layers):
@@ -1192,9 +1169,42 @@ def run_decode_config():
     params["lnf_beta"] = _np.zeros(d, _np.float32)
     params["pred_weight"] = (rng.randn(v, d) * 0.2).astype(_np.float32)
     params["pred_bias"] = _np.zeros(v, _np.float32)
+    return DecodeModel.from_arg_params(
+        params, DecodeSpec(num_heads=h, num_kv_heads=hkv))
 
-    spec = DecodeSpec(num_heads=h, num_kv_heads=hkv)
-    model = DecodeModel.from_arg_params(params, spec)
+
+def run_decode_config():
+    """Continuous-batching decode A/B (BENCH_MODEL=decode): the same
+    generate workload (BENCH_DECODE_STREAMS prompts x BENCH_DECODE_NEW
+    greedy tokens on a tiny transformer LM) through arm A = the
+    DecodeScheduler (iteration-level batching over slot-allocated KV
+    slabs, one fixed-shape decode program) and arm B = the naive serving
+    baseline (one sequence at a time, FULL-context re-prefill for every
+    token — what serving autoregression costs without a KV cache). Both
+    arms share compiled programs built before timing; each repeat runs
+    the arms BACK-TO-BACK and value = median of the per-repeat paired
+    tokens/sec ratios (checkpoint-bench idiom: paired ratios, not
+    min-vs-min, or CPU drift swings the number more than the gate).
+    ISSUE 9 gate: >= 2x, so vs_baseline = value / 2.0."""
+    import numpy as _np
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving.generate import (DecodePrograms, DecodeScheduler,
+                                            GenerateConfig)
+
+    v = int(os.environ.get("BENCH_DECODE_VOCAB", "64"))
+    d = int(os.environ.get("BENCH_DECODE_DIM", "32"))
+    n_layers = int(os.environ.get("BENCH_DECODE_LAYERS", "2"))
+    h, hkv = 4, 2
+    n_streams = int(os.environ.get("BENCH_DECODE_STREAMS", "8"))
+    prompt_len = int(os.environ.get("BENCH_DECODE_PROMPT", "6"))
+    new_tokens = int(os.environ.get("BENCH_DECODE_NEW", "24"))
+    slots = int(os.environ.get("BENCH_DECODE_SLOTS", "4"))
+    repeats = max(1, int(os.environ.get("BENCH_DECODE_REPEATS", "5")))
+    max_context = prompt_len + new_tokens + 2
+
+    rng = _np.random.RandomState(3)
+    model = _decode_bench_model(v, d, n_layers, h, hkv)
     prompts = [list(rng.randint(1, v, prompt_len)) for _ in range(n_streams)]
 
     # arm A: scheduler built + programs compiled ONCE before timing
@@ -1283,6 +1293,125 @@ def run_decode_config():
     }
 
 
+def run_decode_paged_config():
+    """Paged-KV decode A/B (BENCH_MODEL=decode, second record, ISSUE 13):
+    a shared-system-prompt workload (every prompt = the same system
+    prefix + a unique tail) through arm P = the paged scheduler
+    (MXNET_DECODE_PAGED: block pool + block tables + copy-on-write
+    prefix reuse) and arm U = the unpaged scheduler at the SAME usable
+    KV rows (unpaged slots x max_context == paged num_blocks x
+    block_tokens; the paged arm additionally carries one trash block).
+    Fixed memory is the whole point: unpaged co-residency is capped at
+    slots = rows/max_context, while paged admission is governed by
+    free blocks actually touched plus hash-shared prefix blocks, so the
+    same bytes hold more live sequences AND skip re-prefilling the
+    system prompt. Each repeat runs the arms BACK-TO-BACK (paired
+    ratios, same idiom as the continuous-batching record) and the two
+    arms' token streams are asserted identical every repeat — paged is
+    a layout change, not a numerics change. value = median paired
+    tokens/sec ratio; ISSUE 13 gate: >= 1.5x end-to-end, so
+    vs_baseline = value / 1.5. prefix_savings_pct (gated >= 50% in the
+    CI dryrun) rides along from the scheduler's own counters."""
+    import numpy as _np
+
+    from mxnet_tpu.serving.generate import DecodeScheduler, GenerateConfig
+
+    v = int(os.environ.get("BENCH_DECODE_VOCAB", "64"))
+    d = int(os.environ.get("BENCH_DECODE_DIM", "32"))
+    n_layers = int(os.environ.get("BENCH_DECODE_LAYERS", "2"))
+    h, hkv = 4, 2
+    n_streams = int(os.environ.get("BENCH_PAGED_STREAMS", "24"))
+    # 25 = 3 full blocks + 1 token into the boundary block, so sharers
+    # exercise BOTH reuse modes: whole-block aliasing AND the CoW fork
+    sys_len = int(os.environ.get("BENCH_PAGED_SYS", "25"))
+    new_tokens = int(os.environ.get("BENCH_PAGED_NEW", "6"))
+    block_tokens = int(os.environ.get("BENCH_PAGED_BLOCK_TOKENS", "8"))
+    repeats = max(1, int(os.environ.get("BENCH_PAGED_REPEATS", "5")))
+    # the server is provisioned for WORST-CASE contexts (128 tokens) but
+    # this traffic touches ~32 rows/stream — the shape where unpaged
+    # reservation (max_context rows per slot, used or not) wastes the
+    # pool and paged reservation (blocks actually touched) does not
+    max_context = int(os.environ.get("BENCH_PAGED_CTX", "128"))
+    unpaged_slots = int(os.environ.get("BENCH_PAGED_UNPAGED_SLOTS", "2"))
+    # byte-equivalent pools: 32 blocks x 8 tokens == 2 slots x 128 rows
+    # (the paged arm carries one extra trash block on top)
+    num_blocks = unpaged_slots * max_context // block_tokens
+    paged_slots = int(os.environ.get("BENCH_PAGED_SLOTS", "12"))
+
+    model = _decode_bench_model(v, d, n_layers, h, hkv)
+    rng = _np.random.RandomState(7)
+    sys_prompt = [int(t) for t in rng.randint(1, v, sys_len)]
+    prompts = [sys_prompt + [1 + (i % (v - 2))] for i in range(n_streams)]
+    prompt_len = len(prompts[0])
+    # suffix bucket for sharers + one full bucket for the cold prompt
+    buckets = (4, 1 << (prompt_len - 1).bit_length())
+
+    def mk(paged):
+        return DecodeScheduler(model, GenerateConfig(
+            num_heads=h, num_kv_heads=hkv,
+            slots=paged_slots if paged else unpaged_slots,
+            max_context=max_context, prefill_buckets=buckets,
+            max_new_tokens=new_tokens, queue_depth=max(64, 2 * n_streams),
+            paged=paged, block_tokens=block_tokens,
+            num_blocks=num_blocks, prefix_share=True))
+
+    scheds = {True: mk(True), False: mk(False)}
+    for s in scheds.values():
+        s.start()
+
+    def arm(paged):
+        sched = scheds[paged]
+        t0 = time.perf_counter()
+        streams = [sched.submit(p, max_new_tokens=new_tokens)
+                   for p in prompts]
+        outs = [s.tokens(timeout=300.0) for s in streams]
+        dt = time.perf_counter() - t0
+        return sum(len(o) for o in outs) / dt, outs
+
+    # warmup compiles both arms' program sets before timing
+    arm(True)
+    arm(False)
+
+    paged_tps, unpaged_tps, ratios = [], [], []
+    for _ in range(repeats):
+        tps_p, paged_outs = arm(True)
+        tps_u, unpaged_outs = arm(False)
+        # the headline is only meaningful if the arms ran the SAME
+        # computation: paged streams must be token-identical to unpaged
+        assert paged_outs == unpaged_outs, "paged/unpaged arms diverged"
+        paged_tps.append(tps_p)
+        unpaged_tps.append(tps_u)
+        ratios.append(tps_p / tps_u)
+    st_p = scheds[True].stats()
+    st_u = scheds[False].stats()
+    for s in scheds.values():
+        s.stop(drain=True)
+    # cumulative over warmup + repeats: every run resubmits the same mix
+    total_prompt = n_streams * prompt_len * (repeats + 1)
+    savings_pct = 100.0 * st_p["prefix_tokens_saved"] / total_prompt
+    speedup = statistics.median(ratios)
+    return {
+        "metric": "decode_paged_kv",
+        "value": round(speedup, 3),
+        "unit": "tokens_per_sec_vs_unpaged_same_kv_bytes",
+        # the >= 1.5x gate: >= 1.0 passes
+        "vs_baseline": round(speedup / 1.5, 3),
+        "paged_tokens_per_sec": round(statistics.median(paged_tps), 1),
+        "unpaged_tokens_per_sec": round(statistics.median(unpaged_tps), 1),
+        "prefix_savings_pct": round(savings_pct, 1),
+        "prefix_hits": st_p["prefix_hits"],
+        "cow_forks": st_p["cow_forks"],
+        "paged_compiles": st_p["compiles"],
+        "unpaged_compiles": st_u["compiles"],
+        "blocks": num_blocks, "block_tokens": block_tokens,
+        "paged_slots": paged_slots, "unpaged_slots": unpaged_slots,
+        "streams": n_streams, "new_tokens": new_tokens,
+        "prompt_len": prompt_len, "repeats": repeats,
+        "model": "LM V%d D%d L%dx%dh ctx%d" % (v, d, n_layers, h,
+                                               max_context),
+    }
+
+
 def main():
     try:
         _main()
@@ -1307,6 +1436,7 @@ def _main():
         return
     if which == "decode":
         _emit(run_decode_config())
+        _emit(run_decode_paged_config())
         return
     if os.environ.get("BENCH_LM_SWEEP"):
         # transformer (bs, seq) MFU table (docs/perf.md); one JSON line

@@ -42,7 +42,7 @@ echo "== stage 5: serving tests (dynamic batching + bucketed compile cache) =="
 # (batch former windows, deadlines, engine-dispatch pipelining), so it gets
 # its own stage where a hang or flake is attributable. Then the end-to-end
 # dry-run: concurrent clients -> occupancy/cache-hit assertions.
-JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py tests/test_serving_generate.py -q
+JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py tests/test_serving_generate.py tests/test_paged_decode.py -q
 # Both end-to-end dry-runs below run with the engine happens-before
 # sanitizer ON: the serving/decode dispatch paths must produce ZERO race
 # reports (docs/concurrency.md sanitizer section).
@@ -53,7 +53,11 @@ assert engine.sanitizer_reports() == [], engine.sanitizer_reports()
 print('sanitizer: 0 reports (serving)')"
 # Continuous-batching decode gate: staggered generate streams must emit
 # token streams identical to sequential generation, with fresh compiles
-# bounded by the fixed program set and a clean mid-stream drain.
+# bounded by the fixed program set and a clean mid-stream drain. Includes
+# the paged-KV wave (ISSUE 13): shared-prefix streams at fixed KV bytes
+# must run >= 2x the unpaged slot-equivalent co-residency, save >= 50% of
+# prefill tokens via shared blocks, stay bitwise-identical to the unpaged
+# arm, and add zero steady-state compiles — all sanitizer-clean.
 JAX_PLATFORMS=cpu MXNET_ENGINE_SANITIZER=1 python -c "
 import __graft_entry__ as g; g.dryrun_decode()
 from mxnet_tpu import engine

@@ -82,6 +82,10 @@ LOCK_HIERARCHY: Dict[str, int] = {
     "serving.generate.scheduler.DecodeScheduler._cond": 50,
     # decode leaves: slot bookkeeping and per-stream token delivery only.
     "serving.generate.kv_cache.KVCacheManager._lock": 100,
+    # paged block-table lock: leaf — block/refcount/prefix-registry
+    # bookkeeping only; engine pushes, device calls, and telemetry all
+    # happen outside the hold.
+    "serving.generate.paged.PagedKVCacheManager._lock": 100,
     "serving.generate.stream.TokenStream._cond": 100,
     # predictor run path: leaf — forward() holds it across the compiled
     # call but never acquires anything ranked inside.

@@ -188,3 +188,36 @@ def cached_attention(q, k_cache, v_cache, lengths):
     mask = jnp.arange(cap)[None, :] <= lengths[:, None]  # (B, C)
     return _grouped_attention(q, k_cache, v_cache, hkv, causal=False,
                               mask=mask)
+
+
+def prefix_cached_attention(q, k_ctx, v_ctx, ctx_len, k_new, v_new):
+    """Chunked prefill against a cached prefix (the paged-KV admit path).
+
+    ``q``: (B, H, Tq, D) — queries for ``Tq`` new suffix tokens (already
+    roped at absolute positions ``ctx_len + j``). ``k_ctx``/``v_ctx``:
+    (B, Hkv, C, D) — the cached prefix at fixed capacity C, valid in
+    positions ``[0, ctx_len)``; everything at/after ``ctx_len`` is masked
+    to exactly zero probability. ``k_new``/``v_new``: (B, Hkv, Tq, D) —
+    the suffix's own keys/values, attended causally (suffix token i sees
+    suffix keys 0..i).
+
+    Same grouped-einsum math and f32 softmax as ``cached_attention`` —
+    masked lanes contribute exactly 0.0 to the softmax sum, so with
+    ``ctx_len == 0`` the result equals plain causal self-attention over
+    the suffix, and a shared cached prefix yields the same output as
+    recomputing that prefix in-band.
+    """
+    hkv = k_ctx.shape[1]
+    cap = k_ctx.shape[2]
+    tq = q.shape[2]
+    k_all = jnp.concatenate([k_ctx, k_new], axis=2)
+    v_all = jnp.concatenate([v_ctx, v_new], axis=2)
+    # ctx keys valid below ctx_len; suffix keys gated by the causal term
+    # inside _grouped_attention (idx_q = i + cap admits all ctx keys and
+    # exactly the causal suffix prefix).
+    ctx_valid = jnp.arange(cap)[None, :] < ctx_len
+    suf_valid = jnp.ones((1, tq), bool)
+    mask = jnp.concatenate(
+        [jnp.broadcast_to(ctx_valid, (q.shape[0], cap)),
+         jnp.broadcast_to(suf_valid, (q.shape[0], tq))], axis=1)
+    return _grouped_attention(q, k_all, v_all, hkv, causal=True, mask=mask)

@@ -30,6 +30,7 @@ from .batcher import BatchFormer, Request, ServingError
 from .bucket_cache import BucketCache
 from .generate import (DecodeModel, DecodePrograms, DecodeScheduler,
                        DecodeSpec, GenerateConfig, KVCacheManager,
+                       PagedDecodePrograms, PagedKVCacheManager,
                        TokenStream)
 from .metrics import ServingBatchEndParam, ServingMetrics
 from .server import InferenceServer, ServingConfig, create_server
@@ -41,5 +42,6 @@ __all__ = [
     "ServingBatchEndParam", "ServingMetrics", "InferenceServer",
     "ServingConfig", "create_server", "StagingPool", "BucketTuner",
     "DecodeModel", "DecodeSpec", "DecodePrograms", "KVCacheManager",
+    "PagedDecodePrograms", "PagedKVCacheManager",
     "DecodeScheduler", "GenerateConfig", "TokenStream",
 ]

@@ -8,13 +8,15 @@ compiles to (prefill ladder + decode step + admit) per replica via the
 persistent program cache. Front door: ``InferenceServer.generate()`` /
 ``submit_stream()`` (serving/server.py).
 """
-from .kv_cache import KVCacheManager
+from .kv_cache import AdmitPlan, KVCacheManager
 from .model import DecodeModel, DecodeSpec
-from .programs import DecodePrograms
+from .paged import PagedKVCacheManager
+from .programs import DecodePrograms, PagedDecodePrograms
 from .scheduler import DecodeScheduler, GenerateConfig
 from .stream import TokenStream
 
 __all__ = [
-    "DecodeModel", "DecodeSpec", "DecodePrograms", "KVCacheManager",
+    "AdmitPlan", "DecodeModel", "DecodeSpec", "DecodePrograms",
+    "KVCacheManager", "PagedDecodePrograms", "PagedKVCacheManager",
     "DecodeScheduler", "GenerateConfig", "TokenStream",
 ]

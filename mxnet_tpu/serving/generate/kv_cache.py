@@ -14,7 +14,9 @@ is never held across an engine push or device call.
 """
 from __future__ import annotations
 
+import dataclasses
 import threading
+from collections import deque
 from typing import List, Optional
 
 import numpy as np
@@ -22,6 +24,25 @@ import numpy as np
 from ... import engine as _engine
 from ..batcher import ServingError
 from .programs import DecodePrograms
+
+
+@dataclasses.dataclass
+class AdmitPlan:
+    """Everything the scheduler needs to turn one queued prompt into one
+    admission op — the shared currency of ``KVCacheManager.try_admit``
+    and ``PagedKVCacheManager.try_admit``. Unpaged plans carry only
+    (slot, suffix = whole prompt); paged plans add the cached-prefix
+    length, the block table, and the copy-on-write fork pair."""
+    slot: int
+    suffix: List[int]          # tokens the prefill program must run
+    ctx_len: int = 0           # cached-prefix tokens reused (0 = cold)
+    table: Optional[np.ndarray] = None   # (max_blocks,) i32, paged only
+    fork_src: int = 0          # shared block to CoW-copy (0 = no fork)
+    fork_dst: int = 0          # private target of the copy (0 = no fork)
+
+    @property
+    def forked(self) -> bool:
+        return self.fork_dst != 0
 
 
 class KVCacheManager:
@@ -40,6 +61,9 @@ class KVCacheManager:
         # (prompt + generated so far); owner[i] = opaque sequence tag
         self._lengths = np.zeros(self.slots, np.int32)
         self._owner: List[Optional[object]] = [None] * self.slots
+        # explicit free list so alloc/free are O(1) — a linear scan under
+        # the lock is invisible at 4 slots but not at paged-scale counts
+        self._free_slots: deque = deque(range(self.slots))
 
     # --- slot bookkeeping (host-only, leaf lock) -------------------------
     def alloc(self, owner, prompt_len: int) -> Optional[int]:
@@ -49,17 +73,30 @@ class KVCacheManager:
                 "prompt length %d exceeds kv capacity %d"
                 % (prompt_len, self.capacity), code="too_large")
         with self._lock:
-            for i in range(self.slots):
-                if self._owner[i] is None:
-                    self._owner[i] = owner
-                    self._lengths[i] = prompt_len
-                    return i
-        return None
+            if not self._free_slots:
+                return None
+            slot = self._free_slots.popleft()
+            self._owner[slot] = owner
+            self._lengths[slot] = prompt_len
+            return slot
+
+    def try_admit(self, owner, prompt, max_new: int) -> Optional[AdmitPlan]:
+        """Admission in plan form (the scheduler's single entry point for
+        both cache kinds). Unpaged: a slot is the whole reservation and
+        the suffix is the whole prompt; ``max_new`` is unused because
+        every slot already owns a full-capacity lane."""
+        slot = self.alloc(owner, len(prompt))
+        if slot is None:
+            return None
+        return AdmitPlan(slot=slot, suffix=[int(t) for t in prompt])
 
     def free(self, slot: int):
         with self._lock:
+            if self._owner[slot] is None:
+                return                      # idempotent double-free guard
             self._owner[slot] = None
             self._lengths[slot] = 0
+            self._free_slots.append(slot)
 
     def advance(self, slot: int) -> int:
         """Record one decoded token in ``slot``; returns the new length."""
@@ -104,6 +141,7 @@ class KVCacheManager:
         with self._lock:
             self._lengths[:] = 0
             self._owner = [None] * self.slots
+            self._free_slots = deque(range(self.slots))
         self.k_slab, self.v_slab = self.programs.fresh_slabs()
 
     def kv_bytes(self) -> int:

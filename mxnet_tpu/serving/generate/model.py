@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...ops.attention import cached_attention, rope
+from ...ops.attention import cached_attention, prefix_cached_attention, rope
 from ..batcher import ServingError
 
 
@@ -249,6 +249,161 @@ class DecodeModel:
                 v_l = jax.vmap(write)(v_slab[l], v_t, lengths)
                 k_slab = k_slab.at[l].set(k_l)
                 v_slab = v_slab.at[l].set(v_l)
+                att = cached_attention(q, k_l, v_l, lengths)
+                att = att.transpose(0, 2, 1, 3).reshape(slots, dm)
+                x = x + att @ params["wo"][l].T
+                h2 = _ln(x, params["ln2_g"][l], params["ln2_b"][l])
+                h2 = jax.nn.gelu(h2 @ params["w1"][l].T + params["b1"][l])
+                x = x + (h2 @ params["w2"][l].T + params["b2"][l])
+            logits = _ln(x, params["lnf_g"], params["lnf_b"]) \
+                @ params["pred_w"].T + params["pred_b"]
+            return logits, k_slab, v_slab
+
+        return decode
+
+    def paged_slab_shape(self, num_blocks: int, block_tokens: int) -> tuple:
+        """(L, num_blocks, Hkv, T, Dh) — one of the two paged slabs.
+        ``num_blocks`` INCLUDES physical block 0, the reserved /dev/null
+        block inactive lanes and padded positions write into."""
+        return (self.layers, num_blocks, self.spec.hkv, block_tokens,
+                self.head_dim)
+
+    def build_paged_prefill(self, bucket: int, block_tokens: int,
+                            max_blocks: int):
+        """Pure fn (params, k_slab, v_slab, table (MB,) i32, ctx_len ()
+        i32, tokens (1, T=bucket) i32, n (1,) i32, fork_src () i32,
+        fork_dst () i32) -> (logits (1, V), k_slab, v_slab).
+
+        The paged admit path folds THREE things into one donated-slab
+        program so the program set stays (ladder + one decode):
+
+        1. **Copy-on-write fork**: physical block ``fork_src`` is copied
+           into ``fork_dst`` first (both 0 — the trash block — when no
+           fork), so a suffix that diverges inside a shared prefix block
+           lands in a private copy while every other sharer keeps reading
+           the original.
+        2. **Chunked prefill over the cached prefix**: the first
+           ``ctx_len`` positions are gathered from the slab via ``table``
+           (shared prefix blocks materialize ONCE and are only read
+           here); the ``n`` suffix tokens attend to that prefix plus
+           causally to each other, roped at absolute positions
+           ``ctx_len + j``.
+        3. **Admit**: each suffix position's k/v is scattered to physical
+           block ``table[(ctx_len + j) // T]`` offset ``(ctx_len + j) % T``
+           (padded positions j >= n go to trash block 0).
+        """
+        spec = self.spec
+        T = int(block_tokens)
+        mb = int(max_blocks)
+        cap = T * mb
+
+        def prefill(params, k_slab, v_slab, table, ctx_len, tokens, n,
+                    fork_src, fork_dst):
+            self_p = DecodeModel.__new__(DecodeModel)
+            self_p.params = params
+            self_p.spec = spec
+            self_p.vocab, self_p.dm = params["embed"].shape
+            self_p.layers = params["wq"].shape[0]
+            self_p.head_dim = self_p.dm // spec.num_heads
+            hkv = spec.hkv
+            ctx_len = ctx_len.astype(jnp.int32)
+            table = table.astype(jnp.int32)
+            # (1) CoW fork: materialize the divergent block privately
+            # before anything reads through the table (whose boundary
+            # entry already names fork_dst).
+            k_slab = k_slab.at[:, fork_dst].set(k_slab[:, fork_src])
+            v_slab = v_slab.at[:, fork_dst].set(v_slab[:, fork_src])
+            x = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
+            j = jnp.arange(bucket, dtype=jnp.int32)
+            pos = ctx_len + j                       # absolute positions
+            # suffix k/v land at table[pos // T] : pos % T; padded lanes
+            # (j >= n) land in trash block 0 (never read unmasked)
+            phys = jnp.where(j < n[0],
+                             table[jnp.clip(pos // T, 0, mb - 1)], 0)
+            off = pos % T
+            for l in range(self_p.layers):
+                h = _ln(x, params["ln1_g"][l], params["ln1_b"][l])
+                q, k, v = self_p._project(h, l, 1, bucket)
+                q = rope(q, positions=pos, base=spec.rope_base)
+                k = rope(k, positions=pos, base=spec.rope_base)
+                # (2) gather the cached prefix through the block table
+                k_ctx = k_slab[l][table].transpose(1, 0, 2, 3) \
+                    .reshape(1, hkv, cap, self_p.head_dim)
+                v_ctx = v_slab[l][table].transpose(1, 0, 2, 3) \
+                    .reshape(1, hkv, cap, self_p.head_dim)
+                att = prefix_cached_attention(q, k_ctx, v_ctx, ctx_len,
+                                              k, v)
+                att = att.transpose(0, 2, 1, 3).reshape(1, bucket,
+                                                        self_p.dm)
+                x = x + att @ params["wo"][l].T
+                x = self_p._mlp(x, l)
+                # (3) admit: scatter this layer's suffix k/v into place
+                k_slab = k_slab.at[l, phys, :, off, :].set(
+                    k[0].transpose(1, 0, 2))
+                v_slab = v_slab.at[l, phys, :, off, :].set(
+                    v[0].transpose(1, 0, 2))
+            logits = self_p._head(x)  # (1, T, V)
+            last = jnp.take_along_axis(
+                logits, (n - 1).astype(jnp.int32)[:, None, None], axis=1
+            )[:, 0, :]
+            return last, k_slab, v_slab
+
+        return prefill
+
+    def build_paged_decode(self, slots: int, block_tokens: int,
+                           max_blocks: int):
+        """Pure fn (params, k_slab, v_slab, tables (B, MB) i32, lengths
+        (B,) i32, tokens (B,) i32) -> (logits (B, V), k_slab, v_slab).
+
+        The paged twin of ``build_decode``: each row's new k/v is
+        scattered to physical block ``tables[i, lengths[i] // T]`` offset
+        ``lengths[i] % T`` (the scheduler guarantees that block is
+        PRIVATE to row i — copy-on-write resolves sharing before any
+        write is scheduled), then attention gathers the row's dense
+        (Hkv, C, Dh) view through its table and masks by length exactly
+        like the unpaged step. Inactive lanes carry an all-zero table, so
+        their writes land in trash block 0 — wasted lanes, never wrong
+        lanes, same fixed-shape discipline as the unpaged program.
+        """
+        spec = self.spec
+        T = int(block_tokens)
+        mb = int(max_blocks)
+        cap = T * mb
+
+        def decode(params, k_slab, v_slab, tables, lengths, tokens):
+            dm = params["embed"].shape[1]
+            n_layers = params["wq"].shape[0]
+            head_dim = dm // spec.num_heads
+            hkv = spec.hkv
+            lengths = lengths.astype(jnp.int32)
+            tables = tables.astype(jnp.int32)
+            x = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
+            pos = lengths.reshape(slots, 1, 1)
+            # write site per row: its own (always-private) block
+            phys_w = jnp.take_along_axis(
+                tables, jnp.clip(lengths // T, 0, mb - 1)[:, None],
+                axis=1)[:, 0]
+            off_w = lengths % T
+            for l in range(n_layers):
+                h = _ln(x, params["ln1_g"][l], params["ln1_b"][l])
+                q = (h @ params["wq"][l].T).reshape(
+                    slots, spec.num_heads, 1, head_dim)
+                k_t = (h @ params["wk"][l].T).reshape(
+                    slots, hkv, 1, head_dim)
+                v_t = (h @ params["wv"][l].T).reshape(
+                    slots, hkv, 1, head_dim)
+                q = rope(q, positions=pos, base=spec.rope_base)
+                k_t = rope(k_t, positions=pos, base=spec.rope_base)
+                k_slab = k_slab.at[l, phys_w, :, off_w, :].set(
+                    k_t[:, :, 0, :])
+                v_slab = v_slab.at[l, phys_w, :, off_w, :].set(
+                    v_t[:, :, 0, :])
+                # gather each row's dense view (write first, so the new
+                # token's k/v is visible to its own attention)
+                k_l = k_slab[l][tables].transpose(0, 2, 1, 3, 4) \
+                    .reshape(slots, hkv, cap, head_dim)
+                v_l = v_slab[l][tables].transpose(0, 2, 1, 3, 4) \
+                    .reshape(slots, hkv, cap, head_dim)
                 att = cached_attention(q, k_l, v_l, lengths)
                 att = att.transpose(0, 2, 1, 3).reshape(slots, dm)
                 x = x + att @ params["wo"][l].T

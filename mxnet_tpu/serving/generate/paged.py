@@ -1,0 +1,300 @@
+"""Paged KV cache: block pool + per-sequence block tables + prefix reuse.
+
+The vLLM-style replacement for ``KVCacheManager``'s one-contiguous-lane-
+per-slot layout: the two per-replica slabs become a pool of fixed-size
+**blocks** of ``block_tokens`` KV rows each (``MXNET_DECODE_BLOCK_TOKENS``,
+default 16), and every sequence owns a **block table** — a fixed-width
+``(max_blocks,)`` int32 vector naming the physical block holding each
+logical ``block_tokens``-token span of its context. Admission is governed
+by **free-block count** instead of free-slot count, so memory (not the
+slot dimension of the decode program) is what caps co-residency, and a
+short sequence no longer reserves ``max_context`` worth of slab.
+
+Prefix reuse (``MXNET_DECODE_PREFIX_SHARE``, default on): every admitted
+prompt registers its token blocks under a chained content hash. A later
+prompt whose leading blocks hash-match **shares** those physical blocks
+(refcount++) instead of re-prefilling them — the shared-system-prompt
+traffic shape materializes the prefix ONCE. A *partially* filled prompt
+block can be shared too: the joiner's first divergent write would land
+inside it, so the admit program **copy-on-write forks** it — copies the
+shared block into a private one from the joiner's own reservation, then
+writes there. Sharers only ever read shared blocks; every write target is
+private by construction, which is what keeps paged token streams
+bitwise-identical to the unpaged path.
+
+No mid-stream eviction, ever: admission reserves every block the
+sequence can touch through ``min(prompt + max_new, capacity)`` up front,
+so a running sequence never allocates — a waiting prefill is admitted
+only when retirement frees blocks.
+
+Lock discipline: ``_lock`` is a LEAF (rank 100 in ``LOCK_HIERARCHY``) —
+it guards the block table / free-list / refcount / prefix-registry
+bookkeeping only. Engine pushes, device calls, and telemetry increments
+all happen outside the hold; the slabs themselves are serialized by the
+engine var exactly like the unpaged manager.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import engine as _engine
+from ..batcher import ServingError
+from .kv_cache import AdmitPlan
+from .programs import PagedDecodePrograms
+
+#: physical block 0 is the reserved /dev/null block: inactive lanes and
+#: padded prefill positions write into it, and it is never read unmasked.
+TRASH_BLOCK = 0
+
+
+def _chain_hash(prev: str, tokens: Sequence[int]) -> str:
+    """Content hash of one token block, chained on its prefix's hash —
+    equal chains <=> equal token prefixes, block-aligned."""
+    h = hashlib.sha1()
+    h.update(prev.encode())
+    h.update(b"|")
+    h.update(",".join(str(int(t)) for t in tokens).encode())
+    return h.hexdigest()
+
+
+class PagedKVCacheManager:
+    """Block allocator + paged slab holder for one replica's decode state.
+
+    Same surface the scheduler drives on the unpaged ``KVCacheManager``
+    (``try_admit``/``free``/``advance``/``length``/``owner``/
+    ``active_slots``/``occupancy_pct``/``step_arrays``/``swap_slabs``/
+    ``reset``/``kv_bytes``), plus the block-pool introspection the
+    telemetry gauges export (``blocks_free``/``blocks_total``).
+    """
+
+    def __init__(self, programs: PagedDecodePrograms, replica: int = 0,
+                 prefix_share: bool = True):
+        self.programs = programs
+        self.replica = replica
+        self.slots = programs.slots
+        self.capacity = programs.capacity
+        self.block_tokens = programs.block_tokens
+        self.max_blocks = programs.max_blocks
+        self.num_blocks = programs.num_blocks
+        self.prefix_share = bool(prefix_share)
+        self.var = _engine.new_variable()
+        _engine.track_inflight(self.var)
+        self.k_slab, self.v_slab = programs.fresh_slabs()
+        self._lock = threading.Lock()
+        self._lengths = np.zeros(self.slots, np.int32)
+        self._owner: List[Optional[object]] = [None] * self.slots
+        self._free_slots: deque = deque(range(self.slots))
+        # block pool: ids 1..num_blocks (0 = trash), O(1) alloc/free
+        self._free_blocks: deque = deque(range(1, self.num_blocks + 1))
+        self._ref = np.zeros(self.num_blocks + 1, np.int32)
+        self._tables = np.zeros((self.slots, self.max_blocks), np.int32)
+        # prefix registry: chained hash -> full block id, and
+        # chained hash -> (block id, partial token tuple); _block_keys is
+        # the reverse map so a freed block unregisters its entries.
+        self._full_index: Dict[str, int] = {}
+        self._partial_index: Dict[str, Tuple[int, Tuple[int, ...]]] = {}
+        self._block_keys: Dict[int, List[Tuple[str, str]]] = {}
+        # monotonic counters, mirrored into telemetry by the scheduler
+        self.prefix_hits = 0
+        self.prefix_tokens_saved = 0
+        self.cow_forks = 0
+
+    # --- admission (host-only, leaf lock) --------------------------------
+    def try_admit(self, owner, prompt: Sequence[int],
+                  max_new: int) -> Optional[AdmitPlan]:
+        """Claim a slot AND every block ``owner`` can ever write, sharing
+        hash-matched prefix blocks; None if slots or blocks are exhausted
+        (the caller requeues — admission waits on retirement, a running
+        sequence is never evicted)."""
+        prompt = [int(t) for t in prompt]
+        n = len(prompt)
+        if n >= self.capacity:
+            raise ServingError(
+                "prompt length %d leaves no kv capacity (max_context %d)"
+                % (n, self.capacity), code="too_large")
+        T = self.block_tokens
+        max_len = min(n + int(max_new), self.capacity)
+        nb = -(-max_len // T)                  # blocks this stream can touch
+        with self._lock:
+            if not self._free_slots:
+                return None
+            # --- prefix match (full blocks, then one partial block) ------
+            shared: List[int] = []
+            chain = "root"
+            p_full = 0
+            fork_src = TRASH_BLOCK
+            p_part = 0
+            if self.prefix_share:
+                while (len(shared) + 1) * T <= n - 1:
+                    h = _chain_hash(chain, prompt[len(shared) * T:
+                                                  (len(shared) + 1) * T])
+                    bid = self._full_index.get(h)
+                    if bid is None:
+                        break
+                    shared.append(bid)
+                    chain = h
+                p_full = len(shared) * T
+                ent = self._partial_index.get(chain)
+                if ent is not None:
+                    bid, toks = ent
+                    tail = prompt[p_full:]
+                    # leave >= 1 token to prefill: the admit program is
+                    # also how the stream gets its first logits
+                    lim = min(len(toks), len(tail) - 1)
+                    while p_part < lim and toks[p_part] == tail[p_part]:
+                        p_part += 1
+                    if p_part > 0:
+                        fork_src = bid
+            ctx_len = p_full + p_part
+            first_new = len(shared)            # boundary block index
+            need = nb - first_new
+            if need > len(self._free_blocks):
+                return None                    # wait for retirement
+            slot = self._free_slots.popleft()
+            table = np.zeros(self.max_blocks, np.int32)
+            for idx, bid in enumerate(shared):
+                table[idx] = bid
+                self._ref[bid] += 1
+            for k in range(need):
+                bid = self._free_blocks.popleft()
+                table[first_new + k] = bid
+                self._ref[bid] = 1
+            # CoW target: the divergent write lands inside the boundary
+            # block, which is this stream's own first private block
+            fork_dst = int(table[first_new]) if p_part > 0 else TRASH_BLOCK
+            self._register_prompt(prompt, table)
+            self._owner[slot] = owner
+            self._lengths[slot] = n
+            self._tables[slot] = table
+            if ctx_len > 0:
+                self.prefix_hits += 1
+                self.prefix_tokens_saved += ctx_len
+            if fork_dst != TRASH_BLOCK:
+                self.cow_forks += 1
+        return AdmitPlan(slot=slot, suffix=prompt[ctx_len:],
+                         ctx_len=ctx_len, table=table,
+                         fork_src=int(fork_src), fork_dst=int(fork_dst))
+
+    def _register_prompt(self, prompt: Sequence[int], table: np.ndarray):
+        """Index this prompt's token blocks for later sharers (lock held).
+        Only PROMPT tokens are registered — generated tokens land at
+        offsets beyond the registered span, so entries stay valid for the
+        block's whole lifetime. First registration wins."""
+        if not self.prefix_share:
+            return
+        T = self.block_tokens
+        n = len(prompt)
+        chain = "root"
+        j = 0
+        while (j + 1) * T <= n:
+            blk = tuple(int(t) for t in prompt[j * T:(j + 1) * T])
+            prev = chain
+            chain = _chain_hash(prev, blk)
+            bid = int(table[j])
+            if bid != TRASH_BLOCK:
+                if chain not in self._full_index:
+                    self._full_index[chain] = bid
+                    self._block_keys.setdefault(bid, []).append(
+                        ("full", chain))
+                # alias the full block into the partial index too, so a
+                # prompt that is a proper PREFIX of it can still share
+                # (capped token-wise at admission, resolved by CoW fork)
+                if prev not in self._partial_index:
+                    self._partial_index[prev] = (bid, blk)
+                    self._block_keys.setdefault(bid, []).append(
+                        ("partial", prev))
+            j += 1
+        rem = tuple(int(t) for t in prompt[j * T:])
+        if rem:
+            bid = int(table[j])
+            if bid != TRASH_BLOCK and chain not in self._partial_index:
+                self._partial_index[chain] = (bid, rem)
+                self._block_keys.setdefault(bid, []).append(
+                    ("partial", chain))
+
+    def free(self, slot: int):
+        """Release a retired sequence's slot and decref its blocks; a
+        block freed to zero refcount returns to the pool and drops out of
+        the prefix registry."""
+        with self._lock:
+            table = self._tables[slot]
+            for bid in sorted({int(b) for b in table if b != TRASH_BLOCK}):
+                self._ref[bid] -= 1
+                if self._ref[bid] <= 0:
+                    self._ref[bid] = 0
+                    self._free_blocks.append(bid)
+                    for kind, key in self._block_keys.pop(bid, []):
+                        index = (self._full_index if kind == "full"
+                                 else self._partial_index)
+                        index.pop(key, None)
+            self._tables[slot] = 0
+            self._owner[slot] = None
+            self._lengths[slot] = 0
+            self._free_slots.append(slot)
+
+    # --- bookkeeping shared with the unpaged surface ----------------------
+    def advance(self, slot: int) -> int:
+        with self._lock:
+            self._lengths[slot] += 1
+            return int(self._lengths[slot])
+
+    def length(self, slot: int) -> int:
+        with self._lock:
+            return int(self._lengths[slot])
+
+    def owner(self, slot: int):
+        with self._lock:
+            return self._owner[slot]
+
+    def active_slots(self) -> List[int]:
+        with self._lock:
+            return [i for i in range(self.slots)
+                    if self._owner[i] is not None]
+
+    def occupancy_pct(self) -> float:
+        with self._lock:
+            used = sum(1 for o in self._owner if o is not None)
+        return 100.0 * used / self.slots
+
+    def blocks_total(self) -> int:
+        return self.num_blocks
+
+    def blocks_free(self) -> int:
+        with self._lock:
+            return len(self._free_blocks)
+
+    def step_arrays(self):
+        """(lengths, tables) snapshots for the next decode step: inactive
+        rows run with length 0 and an all-trash table — their lanes write
+        into block 0 and read nothing unmasked."""
+        with self._lock:
+            lengths = self._lengths.copy()
+            tables = self._tables.copy()
+        return lengths, tables
+
+    # --- slab plumbing (scheduler thread only) ---------------------------
+    def swap_slabs(self, k_slab, v_slab):
+        self.k_slab, self.v_slab = k_slab, v_slab
+
+    def reset(self):
+        """Fresh slabs + empty bookkeeping (server restart / poisoned
+        step recovery)."""
+        with self._lock:
+            self._lengths[:] = 0
+            self._owner = [None] * self.slots
+            self._free_slots = deque(range(self.slots))
+            self._free_blocks = deque(range(1, self.num_blocks + 1))
+            self._ref[:] = 0
+            self._tables[:] = 0
+            self._full_index.clear()
+            self._partial_index.clear()
+            self._block_keys.clear()
+        self.k_slab, self.v_slab = self.programs.fresh_slabs()
+
+    def kv_bytes(self) -> int:
+        return self.programs.kv_bytes()

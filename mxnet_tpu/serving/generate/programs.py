@@ -205,3 +205,130 @@ class DecodePrograms:
         """Slot a prefilled sequence's K/V into the slabs (donates slabs)."""
         return self._admit(k_slab, v_slab, k_new, v_new,
                            jnp.asarray(slot, jnp.int32))
+
+
+class PagedDecodePrograms(DecodePrograms):
+    """Program set for block/paged KV decode (``MXNET_DECODE_PAGED=1``).
+
+    Two program kinds, both progcache-keyed by lowered StableHLO exactly
+    like the unpaged set, so the paged bound is even TIGHTER than the
+    unpaged one: the bucketed **paged-prefill** ladder (gather cached
+    prefix through the block table + chunked prefill + CoW fork + suffix
+    scatter, all in ONE donated program per rung — there is no separate
+    admit program) and ONE **paged decode** step (scatter each row's new
+    k/v into its private block, gather per-row dense views through the
+    tables, mask by length). Steady state compiles nothing, and a warm
+    restart disk-loads the whole set.
+    """
+
+    def __init__(self, model: DecodeModel, slots: int, capacity: int,
+                 prefill_buckets: Sequence[int], block_tokens: int,
+                 num_blocks: int):
+        buckets = sorted({int(b) for b in prefill_buckets})
+        if not buckets:
+            raise ServingError("decode: empty prefill bucket ladder")
+        if buckets[-1] > capacity:
+            raise ServingError(
+                "decode: prefill bucket %d exceeds kv capacity %d"
+                % (buckets[-1], capacity))
+        if block_tokens < 1:
+            raise ServingError("decode: block_tokens must be >= 1")
+        if num_blocks < 1:
+            raise ServingError("decode: need at least one usable KV block")
+        self.model = model
+        self.slots = int(slots)
+        self.capacity = int(capacity)
+        self.buckets: List[int] = buckets
+        self.block_tokens = int(block_tokens)
+        # MB = per-sequence table width; gathered views are MB*T wide, so
+        # every position < capacity is addressable through a table
+        self.max_blocks = -(-self.capacity // self.block_tokens)
+        self.num_blocks = int(num_blocks)        # usable (excludes trash)
+        self.compiles = 0
+        self.disk_hits = 0
+        self._params_avals = _avals(model.params)
+        self._prefill: Dict[int, _Compiled] = {}
+        slab = jax.ShapeDtypeStruct(
+            model.paged_slab_shape(self.num_blocks + 1, self.block_tokens),
+            jnp.float32)
+        self._slab_aval = slab
+        ints = lambda n: jax.ShapeDtypeStruct((n,), jnp.int32)  # noqa: E731
+        tables = jax.ShapeDtypeStruct((self.slots, self.max_blocks),
+                                      jnp.int32)
+        self._decode = _Compiled(
+            model.build_paged_decode(self.slots, self.block_tokens,
+                                     self.max_blocks),
+            donate=(1, 2), note="paged_decode_step",
+            avals=(self._params_avals, slab, slab, tables,
+                   ints(self.slots), ints(self.slots)),
+            counters=self)
+        self._admit = None      # folded into the paged-prefill programs
+
+    # --- shapes -----------------------------------------------------------
+    def fresh_slabs(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        shape = self.model.paged_slab_shape(self.num_blocks + 1,
+                                            self.block_tokens)
+        return jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
+
+    def kv_bytes(self) -> int:
+        shape = self.model.paged_slab_shape(self.num_blocks + 1,
+                                            self.block_tokens)
+        return 2 * int(np.prod(shape)) * 4
+
+    def _prefill_for(self, bucket: int) -> _Compiled:
+        prog = self._prefill.get(bucket)
+        if prog is None:
+            scalar = jax.ShapeDtypeStruct((), jnp.int32)
+            prog = _Compiled(
+                self.model.build_paged_prefill(bucket, self.block_tokens,
+                                               self.max_blocks),
+                donate=(1, 2), note="paged_prefill_%d" % bucket,
+                avals=(self._params_avals, self._slab_aval,
+                       self._slab_aval,
+                       jax.ShapeDtypeStruct((self.max_blocks,), jnp.int32),
+                       scalar,
+                       jax.ShapeDtypeStruct((1, bucket), jnp.int32),
+                       jax.ShapeDtypeStruct((1,), jnp.int32),
+                       scalar, scalar),
+                counters=self)
+            self._prefill[bucket] = prog
+        return prog
+
+    # --- execution --------------------------------------------------------
+    def paged_prefill(self, k_slab, v_slab, table, ctx_len: int,
+                      suffix: Sequence[int], fork_src: int, fork_dst: int):
+        """Prefill ``suffix`` against the ``ctx_len``-token cached prefix
+        reachable through ``table``, scattering the suffix k/v into its
+        blocks (slabs donated). Returns (last_logits (V,), k, v)."""
+        n = len(suffix)
+        bucket = self.bucket_for(n)
+        if bucket is None:
+            raise ServingError(
+                "suffix length %d exceeds largest prefill bucket %d"
+                % (n, self.buckets[-1]), code="too_large")
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :n] = np.asarray(suffix, np.int32)
+        last, k, v = self._prefill_for(bucket)(
+            self.model.params, k_slab, v_slab,
+            jnp.asarray(table, jnp.int32),
+            jnp.asarray(ctx_len, jnp.int32), jnp.asarray(toks),
+            jnp.asarray([n], jnp.int32),
+            jnp.asarray(fork_src, jnp.int32),
+            jnp.asarray(fork_dst, jnp.int32))
+        return last[0], k, v
+
+    def prefill(self, token_ids: Sequence[int]):
+        raise ServingError("paged decode has no standalone prefill — "
+                           "use paged_prefill (admit is folded in)")
+
+    def admit(self, *a, **kw):
+        raise ServingError("paged decode has no standalone admit — "
+                           "the paged-prefill program scatters in place")
+
+    def decode(self, k_slab, v_slab, tables, lengths, tokens):
+        """One step for every slot, indexed through the block tables.
+        Donates the slabs; use the returned ones."""
+        return self._decode(self.model.params, k_slab, v_slab,
+                            jnp.asarray(tables, jnp.int32),
+                            jnp.asarray(lengths, jnp.int32),
+                            jnp.asarray(tokens, jnp.int32))

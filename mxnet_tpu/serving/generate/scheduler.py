@@ -17,11 +17,20 @@ The decode-step push is optionally routed through an
 ``GenerateConfig.capture``): its signature is occupancy-independent, so
 the steady-state step replays with near-zero host dispatch overhead.
 
+Paged mode (``MXNET_DECODE_PAGED=1``, PR 13): the same loop drives
+``PagedDecodePrograms`` + ``PagedKVCacheManager`` — admission goes
+through ``try_admit`` (block reservation + prefix-hash lookup, returning
+an ``AdmitPlan``), the prefill op becomes one fused paged-prefill
+program (CoW fork + cached-prefix attention + suffix scatter), and the
+decode step carries each row's block table as an extra fixed-shape arg.
+The unpaged path is untouched and remains the bitwise-reference arm.
+
 Lock discipline (declared in ``analysis/lockorder.py``):
 ``DecodeScheduler._cond`` has rank 50 — engine pushes and fences
 (``engine._engine_lock``, rank 20) NEVER happen while it is held;
-``TokenStream._cond`` and ``KVCacheManager._lock`` are leaves (rank 100)
-and may be taken under it.
+``TokenStream._cond``, ``KVCacheManager._lock`` and
+``PagedKVCacheManager._lock`` are leaves (rank 100) and may be taken
+under it.
 """
 from __future__ import annotations
 
@@ -39,7 +48,8 @@ from ... import telemetry as _telemetry
 from ..batcher import ServingError
 from .kv_cache import KVCacheManager
 from .model import DecodeModel
-from .programs import DecodePrograms
+from .paged import PagedKVCacheManager
+from .programs import DecodePrograms, PagedDecodePrograms
 from .stream import TokenStream
 
 
@@ -48,6 +58,11 @@ def _env_int(name, default):
         return int(os.environ.get(name, "") or default)
     except ValueError:
         return default
+
+
+def _env_flag(name, default):
+    return os.environ.get(name, default).lower() \
+        not in ("0", "", "false", "off")
 
 
 def _env_buckets():
@@ -89,6 +104,16 @@ class GenerateConfig:
             "MXNET_DECODE_CAPTURE", "0").lower()
         not in ("0", "", "false", "off"))
     rope_base: float = 10000.0
+    # paged KV (PR 13): block pool + prefix reuse; 0 blocks = auto-size
+    # to byte parity with the unpaged config (slots * ceil(capacity/T))
+    paged: bool = dataclasses.field(
+        default_factory=lambda: _env_flag("MXNET_DECODE_PAGED", "0"))
+    block_tokens: int = dataclasses.field(
+        default_factory=lambda: _env_int("MXNET_DECODE_BLOCK_TOKENS", 16))
+    num_blocks: int = dataclasses.field(
+        default_factory=lambda: _env_int("MXNET_DECODE_BLOCKS", 0))
+    prefix_share: bool = dataclasses.field(
+        default_factory=lambda: _env_flag("MXNET_DECODE_PREFIX_SHARE", "1"))
 
 
 class _Active:
@@ -110,9 +135,16 @@ class DecodeScheduler:
                  replicas: int = 1):
         self.config = config
         self.model = model
-        self.programs = DecodePrograms(model, config.slots,
-                                       config.max_context,
-                                       config.prefill_buckets)
+        if config.paged:
+            blocks = config.num_blocks or config.slots * (
+                -(-config.max_context // config.block_tokens))
+            self.programs: DecodePrograms = PagedDecodePrograms(
+                model, config.slots, config.max_context,
+                config.prefill_buckets, config.block_tokens, blocks)
+        else:
+            self.programs = DecodePrograms(model, config.slots,
+                                           config.max_context,
+                                           config.prefill_buckets)
         self.replicas = int(replicas)
         self.caches: List[KVCacheManager] = []
         self._cond = threading.Condition()       # rank 50
@@ -132,6 +164,19 @@ class DecodeScheduler:
             help="decode slots occupied, % (mean over replicas)")
         self._m_kv = reg.gauge(
             "kv_bytes", help="bytes held in decode KV slabs")
+        self._m_blocks_free = reg.gauge(
+            "kv_blocks_free",
+            help="free KV blocks in the paged pool (sum over replicas)")
+        self._m_blocks_total = reg.gauge(
+            "kv_blocks_total",
+            help="usable KV blocks in the paged pool (sum over replicas)")
+        self._m_prefix_hits = reg.counter(
+            "decode_prefix_hits_total",
+            help="admissions that reused a shared KV prefix")
+        self._m_prefix_saved = reg.counter(
+            "decode_prefix_tokens_saved_total",
+            help="prompt tokens served from shared prefix blocks "
+                 "instead of being re-prefilled")
 
     # --- lifecycle --------------------------------------------------------
     def start(self):
@@ -139,8 +184,18 @@ class DecodeScheduler:
             if self._state != "stopped":
                 return
             self._state = "running"
-        self.caches = [KVCacheManager(self.programs, i)
-                       for i in range(self.replicas)]
+        if self.config.paged:
+            self.caches = [
+                PagedKVCacheManager(self.programs, i,
+                                    prefix_share=self.config.prefix_share)
+                for i in range(self.replicas)]
+            self._m_blocks_total.set(
+                sum(c.blocks_total() for c in self.caches))
+            self._m_blocks_free.set(
+                sum(c.blocks_free() for c in self.caches))
+        else:
+            self.caches = [KVCacheManager(self.programs, i)
+                           for i in range(self.replicas)]
         use_capture = self.config.capture or _engine.capture_enabled()
         self._captures = [
             _engine.CapturedSequence(name="decode_step_r%d" % i)
@@ -244,6 +299,9 @@ class DecodeScheduler:
             self._step_all()
             occ = [c.occupancy_pct() for c in self.caches]
             self._m_occ.set(sum(occ) / max(1, len(occ)))
+            if self.config.paged and self.caches:
+                self._m_blocks_free.set(
+                    sum(c.blocks_free() for c in self.caches))
 
     def _expire_and_cancel(self):
         now = time.monotonic()
@@ -293,10 +351,13 @@ class DecodeScheduler:
         return best
 
     def _admit_waiting(self):
-        """Prefill waiting prompts into free slots. Each admission is one
-        engine op on the target replica's kv var (prefill → slot insert →
-        first-token sample), fenced as a group so fresh sequences join the
-        very next decode step."""
+        """Prefill waiting prompts into free slots (unpaged) / free blocks
+        (paged). Each admission is one engine op on the target replica's
+        kv var (prefill → slot insert → first-token sample), fenced as a
+        group so fresh sequences join the very next decode step. Paged
+        plans may reuse a cached prefix: the op runs only the suffix, and
+        a copy-on-write fork (fused into the same program) privatizes a
+        partially-shared boundary block first."""
         admitted = []         # (active, holder)
         touched = []
         while True:
@@ -308,30 +369,61 @@ class DecodeScheduler:
                     break
                 stream, prompt = self._queue.popleft()
             cache = self.caches[rep]
-            slot = cache.alloc(stream, len(prompt))
-            if slot is None:      # raced nothing — replica filled; requeue
-                with self._cond:
+            plan = cache.try_admit(stream, prompt, stream.max_new_tokens)
+            if plan is None:      # slots/blocks exhausted — wait for
+                with self._cond:  # retirement, never evict mid-stream
                     self._queue.appendleft((stream, prompt))
                 break
             # build the bucket's prefill program here (scheduler thread)
             # so the engine op never mutates the program dict — two
             # replicas' workers could otherwise race the lazy build
-            self.programs.ensure_prefill(len(prompt))
+            self.programs.ensure_prefill(len(plan.suffix))
+            if plan.ctx_len:
+                self._m_prefix_hits.inc()
+                self._m_prefix_saved.inc(plan.ctx_len)
             holder: Dict[str, object] = {}
-            admitted.append((_Active(stream, rep, slot, 0, 0), holder))
+            admitted.append((_Active(stream, rep, plan.slot, 0, 0), holder))
             touched.append(cache.var)
 
-            def op(cache=cache, prompt=prompt, slot=slot, holder=holder):
-                try:
-                    with _telemetry.span("decode.prefill", domain="serving",
-                                         tokens=len(prompt)):
-                        last, k_new, v_new = self.programs.prefill(prompt)
-                        k, v = self.programs.admit(
-                            cache.k_slab, cache.v_slab, k_new, v_new, slot)
+            if self.config.paged:
+                def op(cache=cache, plan=plan, holder=holder):
+                    def run():
+                        last, k, v = self.programs.paged_prefill(
+                            cache.k_slab, cache.v_slab, plan.table,
+                            plan.ctx_len, plan.suffix,
+                            plan.fork_src, plan.fork_dst)
                         cache.swap_slabs(k, v)
                         holder["token"] = int(np.asarray(last).argmax())
-                except Exception as e:          # noqa: BLE001
-                    holder["error"] = e
+                    try:
+                        with _telemetry.span(
+                                "decode.prefill", domain="serving",
+                                tokens=len(plan.suffix),
+                                reused=plan.ctx_len):
+                            if plan.forked:
+                                with _telemetry.span(
+                                        "decode.cow_fork", domain="serving",
+                                        src=plan.fork_src,
+                                        dst=plan.fork_dst):
+                                    run()
+                            else:
+                                run()
+                    except Exception as e:      # noqa: BLE001
+                        holder["error"] = e
+            else:
+                def op(cache=cache, plan=plan, holder=holder):
+                    try:
+                        with _telemetry.span("decode.prefill",
+                                             domain="serving",
+                                             tokens=len(plan.suffix)):
+                            last, k_new, v_new = \
+                                self.programs.prefill(plan.suffix)
+                            k, v = self.programs.admit(
+                                cache.k_slab, cache.v_slab, k_new, v_new,
+                                plan.slot)
+                            cache.swap_slabs(k, v)
+                            holder["token"] = int(np.asarray(last).argmax())
+                    except Exception as e:      # noqa: BLE001
+                        holder["error"] = e
 
             _engine.push(op, mutable_vars=[cache.var], name="decode.prefill")
         if not admitted:
@@ -381,17 +473,25 @@ class DecodeScheduler:
             for a in actives:
                 lengths[a.slot] = cache.length(a.slot)
                 tokens[a.slot] = a.last_token
+            # paged rows index kv through their block tables (freed rows
+            # are all-trash: they write block 0 and read nothing unmasked)
+            tables = cache.step_arrays()[1] if self.config.paged else None
             holder: Dict[str, object] = {}
             stepped.append((rep, actives, holder))
             touched.append(cache.var)
 
             def op(cache=cache, lengths=lengths, tokens=tokens,
-                   holder=holder):
+                   tables=tables, holder=holder):
                 try:
                     with _telemetry.span("decode.step", domain="serving",
                                          rows=int((lengths > 0).sum())):
-                        logits, k, v = self.programs.decode(
-                            cache.k_slab, cache.v_slab, lengths, tokens)
+                        if tables is not None:
+                            logits, k, v = self.programs.decode(
+                                cache.k_slab, cache.v_slab, tables,
+                                lengths, tokens)
+                        else:
+                            logits, k, v = self.programs.decode(
+                                cache.k_slab, cache.v_slab, lengths, tokens)
                         cache.swap_slabs(k, v)
                         holder["logits"] = np.asarray(logits)
                 except Exception as e:          # noqa: BLE001
@@ -430,6 +530,14 @@ class DecodeScheduler:
         with self._cond:
             queued = len(self._queue)
             active = len(self._active)
-        return {"compiles": self.programs.compiles,
-                "disk_hits": self.programs.disk_hits,
-                "steps": self.steps, "queued": queued, "active": active}
+        st = {"compiles": self.programs.compiles,
+              "disk_hits": self.programs.disk_hits,
+              "steps": self.steps, "queued": queued, "active": active}
+        if self.config.paged and self.caches:
+            st["blocks_total"] = sum(c.blocks_total() for c in self.caches)
+            st["blocks_free"] = sum(c.blocks_free() for c in self.caches)
+            st["prefix_hits"] = sum(c.prefix_hits for c in self.caches)
+            st["prefix_tokens_saved"] = sum(
+                c.prefix_tokens_saved for c in self.caches)
+            st["cow_forks"] = sum(c.cow_forks for c in self.caches)
+        return st

@@ -1,0 +1,301 @@
+"""mxnet_tpu.serving.generate paged KV — block pool / prefix-reuse tests.
+
+Acceptance gates (ISSUE 13): (a) paged decode token streams are
+bitwise-identical to the unpaged reference arm for the same seeds,
+including mid-stream admits and copy-on-write forks; (b) two streams
+sharing a prefix block diverge, fork exactly ONCE, and both match solo
+unpaged generation; (c) block-exhaustion admission — a waiting prefill
+is admitted only when retirement frees blocks, never by mid-stream
+eviction; (d) the paged program set is bounded by construction (prefill
+ladder + ONE decode — no admit program); plus block-allocator /
+prefix-registry units and the O(1) free-list on the unpaged manager.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.serving import ServingError
+from mxnet_tpu.serving.generate import (DecodeModel, DecodePrograms,
+                                        DecodeScheduler, DecodeSpec,
+                                        GenerateConfig, KVCacheManager,
+                                        PagedDecodePrograms,
+                                        PagedKVCacheManager)
+
+V, D, L, F, H, HKV = 32, 16, 2, 32, 4, 2
+
+
+def _lm_params(seed=0):
+    """Random weights under the models/transformer.py naming."""
+    rng = np.random.RandomState(seed)
+    dkv = D // H * HKV
+    p = {"embed_weight": rng.randn(V, D).astype(np.float32) * 0.3}
+    for i in range(L):
+        pre = "layer%d" % i
+        p[pre + "_ln1_gamma"] = np.ones(D, np.float32)
+        p[pre + "_ln1_beta"] = np.zeros(D, np.float32)
+        p[pre + "_q_weight"] = rng.randn(D, D).astype(np.float32) * 0.2
+        p[pre + "_k_weight"] = rng.randn(dkv, D).astype(np.float32) * 0.2
+        p[pre + "_v_weight"] = rng.randn(dkv, D).astype(np.float32) * 0.2
+        p[pre + "_o_weight"] = rng.randn(D, D).astype(np.float32) * 0.2
+        p[pre + "_ln2_gamma"] = np.ones(D, np.float32)
+        p[pre + "_ln2_beta"] = np.zeros(D, np.float32)
+        p[pre + "_ffn1_weight"] = rng.randn(F, D).astype(np.float32) * 0.2
+        p[pre + "_ffn1_bias"] = np.zeros(F, np.float32)
+        p[pre + "_ffn2_weight"] = rng.randn(D, F).astype(np.float32) * 0.2
+        p[pre + "_ffn2_bias"] = np.zeros(D, np.float32)
+    p["lnf_gamma"] = np.ones(D, np.float32)
+    p["lnf_beta"] = np.zeros(D, np.float32)
+    p["pred_weight"] = rng.randn(V, D).astype(np.float32) * 0.2
+    p["pred_bias"] = np.zeros(V, np.float32)
+    return p
+
+
+def _decode_model(seed=0):
+    return DecodeModel.from_arg_params(
+        _lm_params(seed), DecodeSpec(num_heads=H, num_kv_heads=HKV))
+
+
+def _config(**kw):
+    kw.setdefault("slots", 3)
+    kw.setdefault("max_context", 24)
+    kw.setdefault("prefill_buckets", (4, 8))
+    kw.setdefault("max_new_tokens", 6)
+    kw.setdefault("block_tokens", 4)
+    kw.setdefault("num_blocks", 0)
+    kw.setdefault("prefix_share", True)
+    return GenerateConfig(num_heads=H, num_kv_heads=HKV, **kw)
+
+
+def _run(model, prompts, paged, **cfg_kw):
+    """Generate all prompts (submitted together) and return their token
+    streams plus the final scheduler stats."""
+    sched = DecodeScheduler(model, _config(paged=paged, **cfg_kw))
+    sched.start()
+    try:
+        streams = [sched.submit(p) for p in prompts]
+        outs = [list(s) for s in streams]
+        stats = sched.stats()
+    finally:
+        sched.stop(drain=True)
+    return outs, stats
+
+
+def _paged_manager(model, slots=3, capacity=24, block_tokens=4,
+                   num_blocks=0, prefix_share=True, buckets=(4, 8)):
+    blocks = num_blocks or slots * (-(-capacity // block_tokens))
+    progs = PagedDecodePrograms(model, slots, capacity, buckets,
+                                block_tokens, blocks)
+    return PagedKVCacheManager(progs, replica=0, prefix_share=prefix_share)
+
+
+# --- (a)+(b) bitwise parity with the unpaged reference arm -----------------
+
+def test_paged_matches_unpaged_solo():
+    """A single sequence decodes to the identical token stream under the
+    paged and unpaged program sets — the gather/scatter block indirection
+    is numerically invisible."""
+    model = _decode_model()
+    prompt = [3, 7, 1, 9, 4]
+    ref, _ = _run(model, [prompt], paged=False)
+    got, stats = _run(model, [prompt], paged=True)
+    assert got == ref
+    assert stats["cow_forks"] == 0 and stats["prefix_hits"] == 0
+
+
+def test_cow_fork_once_and_bitwise_vs_solo_unpaged():
+    """Two co-resident streams share a prefix block, diverge inside it,
+    fork exactly ONCE, and both match their solo unpaged runs bitwise
+    (the ISSUE's copy-on-write correctness gate)."""
+    model = _decode_model()
+    # block_tokens=4: 6 shared tokens = 1 full block + 2 in the boundary
+    # block -> the joiner must CoW-fork the partially-shared block
+    pa = [3, 7, 1, 9, 4, 2]
+    pb = [3, 7, 1, 9, 4, 2, 5, 8]
+    solo_a, _ = _run(model, [pa], paged=False)
+    solo_b, _ = _run(model, [pb], paged=False)
+    outs, stats = _run(model, [pa, pb], paged=True)
+    assert outs[0] == solo_a[0]
+    assert outs[1] == solo_b[0]
+    assert stats["cow_forks"] == 1
+    assert stats["prefix_hits"] == 1
+    # full block (4) + matched boundary tokens (2) skipped prefill
+    assert stats["prefix_tokens_saved"] == 6
+
+
+def test_paged_matches_unpaged_mid_stream_admit():
+    """More prompts than slots: late arrivals join mid-stream as earlier
+    sequences retire; every stream still matches the unpaged arm bitwise
+    (and exact-duplicate prompts reuse the whole sharable prefix)."""
+    model = _decode_model()
+    prompts = [[3, 7, 1, 9, 4, 2], [3, 7, 1, 9, 4, 2, 5, 8],
+               [11, 5, 2], [3, 7, 1, 9, 4, 2], [6, 6, 1, 2]]
+    ref, _ = _run(model, prompts, paged=False, slots=2)
+    got, stats = _run(model, prompts, paged=True, slots=2)
+    assert got == ref
+    assert stats["prefix_hits"] >= 1
+
+
+# --- (c) block-exhaustion admission ----------------------------------------
+
+def test_block_exhaustion_waits_for_retirement():
+    """With blocks for exactly two reservations, a third submit waits in
+    the queue (blocks, not slots, are the scarce resource: slots=4) and
+    is admitted only when a retirement frees blocks — running streams are
+    never evicted (they emit their full max_new_tokens), and the late
+    stream still matches its solo run bitwise. The gating assert is
+    causal, not timing-based: the third stream's FIRST token arrives
+    after some earlier stream's LAST token."""
+    model = _decode_model()
+    # each stream reserves ceil((8 prompt + 8 new)/4) = 4 blocks
+    prompts = [[3, 7, 1, 9, 4, 2, 5, 8], [11, 5, 2, 6, 1, 12, 9, 3],
+               [8, 2, 13, 4, 1, 7, 6, 10]]
+    solos = [_run(model, [p], paged=True, slots=4, max_context=16,
+                  num_blocks=4, block_tokens=4, max_new_tokens=8,
+                  prefix_share=False)[0][0] for p in prompts]
+    sched = DecodeScheduler(model, _config(
+        paged=True, slots=4, max_context=16, num_blocks=8, block_tokens=4,
+        max_new_tokens=8, prefix_share=False))
+    sched.start()
+    try:
+        streams = [sched.submit(p) for p in prompts]
+        outs = [[] for _ in prompts]
+        stamps = [[] for _ in prompts]
+
+        def consume(i):
+            for tok in streams[i]:
+                outs[i].append(tok)
+                stamps[i].append(time.monotonic())
+
+        threads = [threading.Thread(target=consume, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        st = sched.stats()
+    finally:
+        sched.stop(drain=True)
+    # no mid-stream eviction: every stream ran to its full budget
+    assert [len(o) for o in outs] == [8, 8, 8]
+    assert outs == solos
+    # the queued stream joined only AFTER a retirement freed its blocks
+    assert stamps[2][0] > min(stamps[0][-1], stamps[1][-1])
+    assert st["blocks_free"] == st["blocks_total"] == 8
+
+
+# --- (d) bounded program set ------------------------------------------------
+
+def test_paged_compile_bound():
+    """Paged mode compiles at most ladder + ONE decode (admit is folded
+    into the prefill programs), and steady-state steps add nothing."""
+    model = _decode_model()
+    prompts = [[3, 7, 1, 9, 4, 2], [3, 7, 1], [11, 5, 2, 6, 1, 12, 9, 3]]
+    _outs, stats = _run(model, prompts, paged=True)
+    assert stats["compiles"] + stats["disk_hits"] <= len((4, 8)) + 1
+
+
+def test_paged_programs_reject_unpaged_entry_points():
+    model = _decode_model()
+    progs = PagedDecodePrograms(model, 2, 16, (8,), 4, 8)
+    with pytest.raises(ServingError):
+        progs.prefill([1, 2, 3])
+    with pytest.raises(ServingError):
+        progs.admit(None, None, None, None, 0)
+
+
+# --- allocator / prefix-registry units -------------------------------------
+
+def test_paged_manager_reservation_and_free():
+    """Cold admission reserves ceil(min(prompt+max_new, capacity)/T)
+    blocks up front; free() returns every one and drops the registry
+    entries so a re-admission is cold again."""
+    model = _decode_model()
+    cache = _paged_manager(model, slots=2, capacity=24, block_tokens=4)
+    total = cache.blocks_total()
+    plan = cache.try_admit("a", [3, 7, 1, 9, 4], max_new=6)
+    assert plan is not None and plan.ctx_len == 0 and not plan.forked
+    assert plan.suffix == [3, 7, 1, 9, 4]
+    assert cache.blocks_free() == total - 3     # ceil(11/4)
+    cache.free(plan.slot)
+    assert cache.blocks_free() == total
+    again = cache.try_admit("b", [3, 7, 1, 9, 4], max_new=6)
+    assert again.ctx_len == 0                   # registry was emptied
+
+
+def test_paged_manager_prefix_share_and_refcounts():
+    """A second admission with a matching prefix shares the full blocks
+    (refcounted: they stay allocated until BOTH owners free) and forks
+    the partially-matched boundary block into its own reservation."""
+    model = _decode_model()
+    cache = _paged_manager(model, slots=3, capacity=24, block_tokens=4)
+    total = cache.blocks_total()
+    a = cache.try_admit("a", [3, 7, 1, 9, 4, 2], max_new=6)   # 3 blocks
+    b = cache.try_admit("b", [3, 7, 1, 9, 4, 2, 5, 8], max_new=6)
+    assert b.ctx_len == 6 and b.forked
+    assert b.suffix == [5, 8]
+    assert b.fork_src == int(a.table[1])        # a's boundary block
+    assert b.fork_dst == int(b.table[1])        # b's own private copy
+    assert int(b.table[0]) == int(a.table[0])   # full block shared
+    # b reserved ceil(14/4)=4 blocks but shares 1 -> 3 fresh
+    assert cache.blocks_free() == total - 3 - 3
+    cache.free(a.slot)
+    # the shared full block survives a's exit (b still references it)
+    assert cache.blocks_free() == total - 4
+    cache.free(b.slot)
+    assert cache.blocks_free() == total
+
+
+def test_paged_manager_never_shares_whole_prompt():
+    """An exact-duplicate prompt keeps >= 1 suffix token (the admission
+    program is also how the stream gets its first logits)."""
+    model = _decode_model()
+    cache = _paged_manager(model, slots=3, capacity=24, block_tokens=4)
+    cache.try_admit("a", [3, 7, 1, 9, 4, 2], max_new=6)
+    b = cache.try_admit("b", [3, 7, 1, 9, 4, 2], max_new=6)
+    assert b.ctx_len == 5 and len(b.suffix) == 1
+    c = cache.try_admit("c", [3, 7, 1, 9], max_new=6)      # block-aligned
+    assert c.ctx_len == 3 and len(c.suffix) == 1
+
+
+def test_paged_manager_exhaustion_returns_none():
+    model = _decode_model()
+    cache = _paged_manager(model, slots=4, capacity=16, block_tokens=4,
+                           num_blocks=4, prefix_share=False)
+    a = cache.try_admit("a", [1, 2, 3, 4, 5], max_new=8)   # 4 blocks
+    assert a is not None and cache.blocks_free() == 0
+    assert cache.try_admit("b", [6, 7, 8], max_new=8) is None
+    cache.free(a.slot)
+    assert cache.try_admit("b", [6, 7, 8], max_new=8) is not None
+
+
+def test_paged_manager_rejects_capacity_prompt():
+    model = _decode_model()
+    cache = _paged_manager(model, slots=2, capacity=8, block_tokens=4,
+                           buckets=(8,))
+    with pytest.raises(ServingError):
+        cache.try_admit("a", list(range(1, 9)), max_new=4)
+
+
+# --- unpaged free-list (satellite) -----------------------------------------
+
+def test_unpaged_alloc_free_list():
+    """The unpaged manager's O(1) free-list preserves alloc semantics:
+    slots recycle, exhaustion returns None, free is idempotent."""
+    model = _decode_model()
+    progs = DecodePrograms(model, slots=3, capacity=16,
+                           prefill_buckets=(8,))
+    cache = KVCacheManager(progs, replica=0)
+    slots = [cache.alloc("s%d" % i, 2) for i in range(3)]
+    assert sorted(slots) == [0, 1, 2]
+    assert cache.alloc("s3", 2) is None
+    cache.free(slots[1])
+    cache.free(slots[1])                        # double-free: no-op
+    assert cache.alloc("s4", 2) == slots[1]
+    assert cache.alloc("s5", 2) is None
+    plan = None
+    cache.free(slots[0])
+    plan = cache.try_admit("s6", [5, 4, 3], max_new=4)
+    assert plan is not None and plan.slot == slots[0]
+    assert plan.suffix == [5, 4, 3] and plan.ctx_len == 0
