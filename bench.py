@@ -1412,6 +1412,222 @@ def run_decode_paged_config():
     }
 
 
+def run_quant_weight_config():
+    """Quantized-weight decode A/B (BENCH_MODEL=quant, first record,
+    ISSUE 14): the same generate workload through arm Q = the
+    DecodeScheduler with int8 PTQ weights (per-channel symmetric, W8A8 —
+    the matmuls run int8 x int8 on the MXU's double-rate path; scales
+    ride as program ARGUMENTS so the program set is unchanged) and arm F
+    = the identical f32 scheduler. Model sized so decode is
+    matmul-bound (D=512, 4 layers — at toy widths the host scheduler
+    loop would hide the kernel speedup). Each repeat runs the arms
+    BACK-TO-BACK; value = median paired tokens/sec ratio. ISSUE 14
+    gate: >= 1.3x, so vs_baseline = value / 1.3. Accuracy rides along:
+    every quantized stream must agree with f32 greedy on its FIRST
+    token, and the pooled longest-common-prefix fraction is recorded
+    (greedy forks once an argmax flips; past-fork tokens are not
+    comparable)."""
+    from mxnet_tpu.serving.generate import DecodeScheduler, GenerateConfig
+
+    v = int(os.environ.get("BENCH_QUANT_VOCAB", "64"))
+    d = int(os.environ.get("BENCH_QUANT_DIM", "512"))
+    n_layers = int(os.environ.get("BENCH_QUANT_LAYERS", "4"))
+    h, hkv = 4, 2
+    n_streams = int(os.environ.get("BENCH_QUANT_STREAMS", "8"))
+    prompt_len = int(os.environ.get("BENCH_QUANT_PROMPT", "6"))
+    new_tokens = int(os.environ.get("BENCH_QUANT_NEW", "16"))
+    slots = int(os.environ.get("BENCH_QUANT_SLOTS", "8"))
+    repeats = max(1, int(os.environ.get("BENCH_QUANT_REPEATS", "3")))
+    max_context = prompt_len + new_tokens + 2
+
+    import numpy as _np
+    rng = _np.random.RandomState(3)
+    model = _decode_bench_model(v, d, n_layers, h, hkv)
+    prompts = [list(rng.randint(1, v, prompt_len)) for _ in range(n_streams)]
+    bucket = 1 << (prompt_len - 1).bit_length()
+
+    def mk(qw):
+        return DecodeScheduler(model, GenerateConfig(
+            num_heads=h, num_kv_heads=hkv, slots=slots,
+            max_context=max_context, prefill_buckets=(bucket,),
+            max_new_tokens=new_tokens, queue_depth=max(64, 2 * n_streams),
+            quant_weights=qw))
+
+    scheds = {"int8": mk("int8"), "f32": mk("")}
+    for s in scheds.values():
+        s.start()
+
+    def arm(which):
+        sched = scheds[which]
+        t0 = time.perf_counter()
+        streams = [sched.submit(p, max_new_tokens=new_tokens)
+                   for p in prompts]
+        outs = [s.tokens(timeout=600.0) for s in streams]
+        dt = time.perf_counter() - t0
+        return sum(len(o) for o in outs) / dt, outs
+
+    arm("int8")     # warmup compiles both program sets before timing
+    arm("f32")
+
+    q_tps, f_tps, ratios = [], [], []
+    q_outs = f_outs = None
+    for _ in range(repeats):
+        tps_q, q_outs = arm("int8")
+        tps_f, f_outs = arm("f32")
+        q_tps.append(tps_q)
+        f_tps.append(tps_f)
+        ratios.append(tps_q / tps_f)
+    st_q = scheds["int8"].stats()
+    st_f = scheds["f32"].stats()
+    for s in scheds.values():
+        s.stop(drain=True)
+    # accuracy: first-token exact per stream + pooled LCP fraction
+    agree = total = first = 0
+    for q, r in zip(q_outs, f_outs):
+        n = 0
+        while n < len(q) and n < len(r) and q[n] == r[n]:
+            n += 1
+        agree += n
+        total += len(r)
+        first += int(n >= 1)
+    assert first == n_streams, \
+        "an int8-weight stream diverged from f32 at its FIRST token"
+    speedup = statistics.median(ratios)
+    return {
+        "metric": "quant_weight_decode",
+        "value": round(speedup, 3),
+        "unit": "tokens_per_sec_int8_weights_vs_f32",
+        # the >= 1.3x gate: >= 1.0 passes
+        "vs_baseline": round(speedup / 1.3, 3),
+        "int8_tokens_per_sec": round(statistics.median(q_tps), 1),
+        "f32_tokens_per_sec": round(statistics.median(f_tps), 1),
+        "first_token_agree": "%d/%d" % (first, n_streams),
+        "token_lcp_frac": round(agree / total, 3),
+        "int8_compiles": st_q["compiles"], "f32_compiles": st_f["compiles"],
+        "quant_weights": st_q["quant_weights"],
+        "streams": n_streams, "new_tokens": new_tokens, "slots": slots,
+        "repeats": repeats,
+        "model": "LM V%d D%d L%dx%dh ctx%d" % (v, d, n_layers, h,
+                                               max_context),
+        "timing": "median of %d paired int8/f32 tokens/sec ratios, arms "
+                  "back-to-back per repeat" % repeats,
+    }
+
+
+def run_quant_kv_config():
+    """Low-precision KV capacity A/B (BENCH_MODEL=quant, second record,
+    ISSUE 14): the same oversubscribed paged workload through arm F =
+    f32 KV slabs and arm Q = int8 KV slabs whose block pool is sized to
+    the SAME byte budget (int8 data + the per-position f32 scale slabs
+    it needs — the honest accounting). Capacity is the point: at equal
+    bytes the int8 pool holds ~4x the blocks, so paged admission lets
+    ~4x the sequences decode CO-RESIDENT. Co-residency is measured
+    causally per arm (peak overlap of [first, last]-token intervals,
+    same instrument as the CI decode dryrun). value = int8 peak / f32
+    peak; ISSUE 14 gate: >= 2x at byte-equivalent pools, so
+    vs_baseline = value / 2.0. prefix sharing is OFF in both arms so
+    admission is governed by pool capacity alone."""
+    import threading
+
+    import numpy as _np
+    from mxnet_tpu.serving.generate import DecodeScheduler, GenerateConfig
+
+    v = int(os.environ.get("BENCH_QUANT_VOCAB", "64"))
+    d = int(os.environ.get("BENCH_QUANT_KV_DIM", "32"))
+    n_layers = int(os.environ.get("BENCH_QUANT_KV_LAYERS", "2"))
+    h, hkv = 4, 2
+    n_streams = int(os.environ.get("BENCH_QUANT_KV_STREAMS", "24"))
+    prompt_len = int(os.environ.get("BENCH_QUANT_KV_PROMPT", "10"))
+    new_tokens = int(os.environ.get("BENCH_QUANT_KV_NEW", "6"))
+    block_tokens = int(os.environ.get("BENCH_QUANT_KV_BLOCK_TOKENS", "8"))
+    f32_blocks = int(os.environ.get("BENCH_QUANT_KV_BLOCKS", "8"))
+    slots = int(os.environ.get("BENCH_QUANT_KV_SLOTS", "16"))
+    max_context = int(os.environ.get("BENCH_QUANT_KV_CTX", "32"))
+
+    dkv = d // h * hkv
+    # per-block bytes, both sides of the parity: f32 keeps K+V rows at 4
+    # bytes/elem; int8 keeps them at 1 byte/elem PLUS one f32 scale per
+    # position per slab (the quantization metadata is charged to the
+    # pool, not hidden)
+    bytes_f32 = n_layers * 2 * block_tokens * dkv * 4
+    bytes_int8 = n_layers * 2 * block_tokens * (dkv * 1 + 4)
+    int8_blocks = f32_blocks * bytes_f32 // bytes_int8
+
+    model = _decode_bench_model(v, d, n_layers, h, hkv)
+    rng = _np.random.RandomState(7)
+    prompts = [list(rng.randint(1, v, prompt_len)) for _ in range(n_streams)]
+    bucket = 1 << (prompt_len - 1).bit_length()
+
+    def mk(kv_dtype, blocks):
+        return DecodeScheduler(model, GenerateConfig(
+            num_heads=h, num_kv_heads=hkv, slots=slots,
+            max_context=max_context, prefill_buckets=(bucket,),
+            max_new_tokens=new_tokens, queue_depth=max(64, 2 * n_streams),
+            paged=True, block_tokens=block_tokens, num_blocks=blocks,
+            prefix_share=False, kv_dtype=kv_dtype))
+
+    def arm(kv_dtype, blocks):
+        """Run the full mix, consuming every stream concurrently, and
+        return (peak causal co-residency, token streams)."""
+        sched = mk(kv_dtype, blocks)
+        sched.start()
+        streams = [sched.submit(p, max_new_tokens=new_tokens)
+                   for p in prompts]
+        outs = [[] for _ in streams]
+        spans = [[None, None] for _ in streams]
+
+        def consume(i):
+            for tok in streams[i]:
+                now = time.monotonic()
+                outs[i].append(tok)
+                if spans[i][0] is None:
+                    spans[i][0] = now
+                spans[i][1] = now
+
+        threads = [threading.Thread(target=consume, args=(i,))
+                   for i in range(len(streams))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        sched.stop(drain=True)
+        events = []
+        for lo, hi in spans:
+            assert lo is not None, "a stream produced no tokens"
+            events += [(lo, 1), (hi, -1)]
+        live = peak = 0
+        for _t, delta in sorted(events, key=lambda e: (e[0], -e[1])):
+            live += delta
+            peak = max(peak, live)
+        return peak, outs
+
+    peak_f, outs_f = arm("f32", f32_blocks)
+    peak_q, outs_q = arm("int8", int8_blocks)
+    # int8-KV numerics must not perturb the workload's greedy tokens at
+    # this scale (measured property of the drift gate, not luck — the
+    # per-position scales keep attention scores inside the f32 argmax)
+    agree = sum(int(a == b) for a, b in zip(outs_q, outs_f))
+    ratio = peak_q / max(1, peak_f)
+    return {
+        "metric": "quant_kv_capacity",
+        "value": round(ratio, 2),
+        "unit": "x_co_resident_sequences_int8_vs_f32_same_kv_bytes",
+        # the >= 2x gate: >= 1.0 passes
+        "vs_baseline": round(ratio / 2.0, 3),
+        "f32_co_resident_peak": peak_f, "int8_co_resident_peak": peak_q,
+        "f32_blocks": f32_blocks, "int8_blocks": int8_blocks,
+        "pool_bytes_f32": f32_blocks * bytes_f32,
+        "pool_bytes_int8": int8_blocks * bytes_int8,
+        "block_bytes_ratio": round(bytes_f32 / bytes_int8, 2),
+        "streams_token_equal": "%d/%d" % (agree, n_streams),
+        "streams": n_streams, "block_tokens": block_tokens,
+        "slots": slots, "new_tokens": new_tokens,
+        "prompt_len": prompt_len,
+        "model": "LM V%d D%d L%dx%dh ctx%d" % (v, d, n_layers, h,
+                                               max_context),
+    }
+
+
 def main():
     try:
         _main()
@@ -1437,6 +1653,10 @@ def _main():
     if which == "decode":
         _emit(run_decode_config())
         _emit(run_decode_paged_config())
+        return
+    if which == "quant":
+        _emit(run_quant_weight_config())
+        _emit(run_quant_kv_config())
         return
     if os.environ.get("BENCH_LM_SWEEP"):
         # transformer (bs, seq) MFU table (docs/perf.md); one JSON line

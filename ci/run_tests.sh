@@ -42,7 +42,7 @@ echo "== stage 5: serving tests (dynamic batching + bucketed compile cache) =="
 # (batch former windows, deadlines, engine-dispatch pipelining), so it gets
 # its own stage where a hang or flake is attributable. Then the end-to-end
 # dry-run: concurrent clients -> occupancy/cache-hit assertions.
-JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py tests/test_serving_generate.py tests/test_paged_decode.py -q
+JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py tests/test_serving_generate.py tests/test_paged_decode.py tests/test_quant.py -q
 # Both end-to-end dry-runs below run with the engine happens-before
 # sanitizer ON: the serving/decode dispatch paths must produce ZERO race
 # reports (docs/concurrency.md sanitizer section).
@@ -75,6 +75,17 @@ JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; g.dryrun_progcache()"
 # over the same progcache dir must disk-load the fused executable with
 # zero fresh fuse compiles.
 JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; g.dryrun_fuse()"
+# Quantized-inference gate (ISSUE 14): int8-weight + int8-KV paged decode
+# streams must be bitwise-identical to sequential quantized generation and
+# track the f32 arm's greedy tokens (first-token exact, LCP >= 60%) inside
+# the unchanged paged program bound; the MLP serving pair must hit >= 99%
+# top-5 agreement vs f32 with a warm restart disk-loading the quantized
+# programs at ZERO fresh compiles — all sanitizer-clean.
+JAX_PLATFORMS=cpu MXNET_ENGINE_SANITIZER=1 python -c "
+import __graft_entry__ as g; g.dryrun_quant()
+from mxnet_tpu import engine
+assert engine.sanitizer_reports() == [], engine.sanitizer_reports()
+print('sanitizer: 0 reports (quant)')"
 
 echo "== stage 6: import hygiene =="
 python - <<'EOF'

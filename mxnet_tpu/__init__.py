@@ -25,6 +25,7 @@ from . import executor
 from .executor import Executor
 from . import progcache
 from . import predict
+from . import quant
 from . import serving
 from . import telemetry
 from . import autograd   # transitive deps of the executor surface:

@@ -47,19 +47,34 @@ CHIP_PEAK_BF16 = {
 }
 
 
-def chip_peak_flops(device=None) -> Tuple[float, str]:
-    """(nominal peak bf16 FLOP/s, device_kind) for a jax device.
+#: peak multiplier vs bf16 by compute precision: the MXU runs 8-bit
+#: operands (int8, fp8) at double rate, so an MFU quoted against the
+#: bf16 peak would flatter quantized kernels by 2x.
+PRECISION_PEAK_MULT = {"bf16": 1.0, "float32": 1.0, "f32": 1.0,
+                       "int8": 2.0, "fp8": 2.0, "fp8_e4m3": 2.0}
+
+
+def chip_peak_flops(device=None, precision: str = "bf16"
+                    ) -> Tuple[float, str]:
+    """(nominal peak FLOP/s at ``precision``, device_kind) for a jax
+    device. ``precision`` int8/fp8 doubles the bf16 figure (the MXU's
+    double-rate 8-bit path) — quantized-matmul MFU must be quoted
+    against THIS peak, not the bf16 one, to stay honest.
 
     Returns (0.0, kind) when the chip is unknown (e.g. CPU backend) — MFU
     is then not computable and callers should report throughput only.
     """
     import jax
 
+    mult = PRECISION_PEAK_MULT.get(str(precision).lower())
+    if mult is None:
+        raise ValueError("unknown compute precision %r (have %s)"
+                         % (precision, sorted(PRECISION_PEAK_MULT)))
     if device is None:
         device = jax.devices()[0]
     kind = getattr(device, "device_kind", str(device))
     if kind in CHIP_PEAK_BF16:
-        return CHIP_PEAK_BF16[kind], kind
+        return CHIP_PEAK_BF16[kind] * mult, kind
     # longest-prefix match on the device kind only ("TPU v5 lite core"
     # -> "TPU v5 lite", never "TPU v5 lite" -> the v5p "TPU v5" entry)
     best = ""
@@ -67,7 +82,7 @@ def chip_peak_flops(device=None) -> Tuple[float, str]:
         if kind.startswith(key) and len(key) > len(best):
             best = key
     if best:
-        return CHIP_PEAK_BF16[best], kind
+        return CHIP_PEAK_BF16[best] * mult, kind
     return 0.0, kind
 
 
@@ -99,6 +114,9 @@ def count_flops(sym, **known_shapes) -> Dict[str, float]:
             by_type[opname] = by_type.get(opname, 0.0) + f
             total += f
     by_type["total"] = total
+    # low-precision share, separated so MFU can be quoted per precision:
+    # 8-bit matmuls against the double-rate peak, the rest against bf16
+    by_type["total_lowbit"] = by_type.get("QuantizedFullyConnected", 0.0)
     return by_type
 
 
@@ -122,7 +140,11 @@ def _node_flops(opname, attrs, in_shapes, out_shape) -> float:
         if data is None or w is None:
             return 0.0
         return 2.0 * _prod(data) * _prod(w[1:])
-    if opname == "FullyConnected":
+    if opname in ("FullyConnected", "QuantizedFullyConnected"):
+        # QuantizedFullyConnected: identical MAC count at 8-bit operand
+        # width — it lands in its own by_type bucket, and MFU for that
+        # share must be quoted against chip_peak_flops(precision="int8")
+        # (the double-rate peak), keeping quantized MFU honest.
         w = in_shapes[1]
         if w is None:
             return 0.0

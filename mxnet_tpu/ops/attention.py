@@ -166,7 +166,21 @@ def _grouped_attention(q, k, v, hkv, causal, scale=None, mask=None):
     return out.reshape(b, hh, tq, d)
 
 
-def cached_attention(q, k_cache, v_cache, lengths):
+def dequantize_kv(cache, scale):
+    """Widen an int8 KV cache view back to f32 for the attention einsum.
+
+    ``cache``: (..., Hkv, C, Dh) int8, ``scale``: (..., C) f32 — one
+    scale per cached position, shared across kv heads and head dim (each
+    position is written exactly once, so its scale never needs
+    requantization). The multiply fuses into the einsum's operand read;
+    the HBM-resident slab stays at 1/4 of f32 bytes, which is the whole
+    point (docs/deployment.md "Quantized serving").
+    """
+    return cache.astype(jnp.float32) * scale[..., None, :, None]
+
+
+def cached_attention(q, k_cache, v_cache, lengths, k_scale=None,
+                     v_scale=None):
     """One autoregressive decode step against a padded KV cache.
 
     ``q``: (B, H, 1, D) — the new token's query (already roped at its
@@ -182,7 +196,15 @@ def cached_attention(q, k_cache, v_cache, lengths):
     (``_multi_head_attention``): same grouped-einsum math, f32 softmax,
     Tq=1. The flash kernel's block contract needs Tq >= block, so the
     decode step stays on the einsum path by construction.
+
+    Low-precision caches (``MXNET_DECODE_KV_DTYPE``): bf16 caches flow
+    through the f32-accumulating einsum unchanged; int8 caches carry
+    per-position ``k_scale``/``v_scale`` (..., C) and are widened via
+    :func:`dequantize_kv` at the einsum input.
     """
+    if k_scale is not None:
+        k_cache = dequantize_kv(k_cache, k_scale)
+        v_cache = dequantize_kv(v_cache, v_scale)
     hkv = k_cache.shape[1]
     cap = k_cache.shape[2]
     mask = jnp.arange(cap)[None, :] <= lengths[:, None]  # (B, C)
@@ -190,7 +212,8 @@ def cached_attention(q, k_cache, v_cache, lengths):
                               mask=mask)
 
 
-def prefix_cached_attention(q, k_ctx, v_ctx, ctx_len, k_new, v_new):
+def prefix_cached_attention(q, k_ctx, v_ctx, ctx_len, k_new, v_new,
+                            k_scale=None, v_scale=None):
     """Chunked prefill against a cached prefix (the paged-KV admit path).
 
     ``q``: (B, H, Tq, D) — queries for ``Tq`` new suffix tokens (already
@@ -206,7 +229,17 @@ def prefix_cached_attention(q, k_ctx, v_ctx, ctx_len, k_new, v_new):
     ``ctx_len == 0`` the result equals plain causal self-attention over
     the suffix, and a shared cached prefix yields the same output as
     recomputing that prefix in-band.
+
+    int8 cached prefixes carry per-position ``k_scale``/``v_scale``
+    (..., C), widened at the einsum input like ``cached_attention``;
+    ``k_new``/``v_new`` are always full precision (they were just
+    computed in-register).
     """
+    if k_scale is not None:
+        k_ctx = dequantize_kv(k_ctx, k_scale)
+        v_ctx = dequantize_kv(v_ctx, v_scale)
+    k_new = k_new.astype(k_ctx.dtype)
+    v_new = v_new.astype(v_ctx.dtype)
     hkv = k_ctx.shape[1]
     cap = k_ctx.shape[2]
     tq = q.shape[2]

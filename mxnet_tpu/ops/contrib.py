@@ -130,15 +130,65 @@ def _count_sketch(attrs, data, h, s):
 # ---------------------------------------------------------------------------
 # Quantization (reference contrib/quantize.cc)
 # ---------------------------------------------------------------------------
+#: symmetric target formats: dtype + the largest exactly-representable
+#: magnitude the scale maps absmax onto (int8: 127; fp8-e4m3: 448)
+SYMMETRIC_QMAX = {"int8": 127.0, "fp8_e4m3": 448.0}
+
+
+def _symmetric_dtype(out_type: str):
+    if out_type == "int8":
+        return jnp.int8
+    if out_type == "fp8_e4m3":
+        return jnp.float8_e4m3fn
+    raise ValueError("symmetric quantization supports int8/fp8_e4m3, got %r"
+                     % (out_type,))
+
+
+def quantize_symmetric(data, out_type: str = "int8", axis=None):
+    """Symmetric quantization: ``q = round(data / scale)`` with
+    ``scale = absmax / qmax``. ``axis=None`` is per-tensor (one scalar
+    scale); an int (or tuple) names the CHANNEL axis/axes kept distinct —
+    per-channel scales reduce over every *other* axis, the PTQ weight
+    path (`mxnet_tpu.quant`). Returns ``(q, scale)`` with ``scale``
+    keepdims-shaped so ``q * scale`` broadcasts back. Shared math for the
+    ``quantize``/``dequantize`` contrib ops and the quant pass — one
+    implementation, two surfaces."""
+    qmax = SYMMETRIC_QMAX[out_type]
+    if axis is None:
+        reduce_axes = None
+    else:
+        keep = {a % data.ndim for a in
+                (axis if isinstance(axis, (tuple, list)) else (axis,))}
+        reduce_axes = tuple(a for a in range(data.ndim) if a not in keep)
+    amax = jnp.max(jnp.abs(data), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12).astype(jnp.float32) / qmax
+    q = jnp.clip(jnp.round(data / scale), -qmax, qmax) \
+        if out_type == "int8" else data / scale
+    return q.astype(_symmetric_dtype(out_type)), scale
+
+
+def dequantize_symmetric(q, scale):
+    """Inverse of :func:`quantize_symmetric` (f32 result)."""
+    return q.astype(jnp.float32) * scale.astype(jnp.float32)
+
+
 @defop(
     "quantize",
     arg_names=("data", "min_range", "max_range"),
-    param_spec={"out_type": "uint8"},
+    param_spec={"out_type": "uint8", "axis": None},
     num_outputs=3,
     no_grad_inputs=("min_range", "max_range"),
 )
 def _quantize(attrs, data, min_range, max_range):
-    """Affine-quantize float→uint8 given calibration range."""
+    """Affine-quantize float→uint8 given calibration range; symmetric
+    per-tensor/per-channel int8 / fp8-e4m3 with ``out_type`` set (the
+    calibration ranges are then ignored — scales come from absmax over
+    the non-``axis`` axes and are returned in the range outputs)."""
+    out_type = attrs["out_type"]
+    if out_type in SYMMETRIC_QMAX:
+        q, scale = quantize_symmetric(data, out_type, attrs["axis"])
+        return q, -scale * SYMMETRIC_QMAX[out_type], \
+            scale * SYMMETRIC_QMAX[out_type]
     qmax = 255.0
     scale = qmax / (max_range - min_range)
     q = jnp.clip(jnp.round((data - min_range) * scale), 0, qmax)
@@ -152,6 +202,11 @@ def _quantize(attrs, data, min_range, max_range):
     no_grad_inputs=("data", "min_range", "max_range"),
 )
 def _dequantize(attrs, data, min_range, max_range):
+    if data.dtype in (jnp.int8, jnp.float8_e4m3fn):
+        # symmetric path: max_range carries scale * qmax
+        qmax = SYMMETRIC_QMAX["int8" if data.dtype == jnp.int8
+                              else "fp8_e4m3"]
+        return dequantize_symmetric(data, max_range / qmax)
     scale = (max_range - min_range) / 255.0
     return data.astype(jnp.float32) * scale + min_range
 

@@ -59,6 +59,39 @@ def _fully_connected(attrs, data, weight, bias=None):
     return out
 
 
+# --- QuantizedFullyConnected ------------------------------------------------
+@defop(
+    "QuantizedFullyConnected",
+    arg_names=lambda attrs: (
+        ("data", "weight", "scale") if attrs.get("no_bias")
+        else ("data", "weight", "scale", "bias")),
+    param_spec={"num_hidden": 0, "no_bias": False, "flatten": True,
+                "act_dtype": "int8"},
+    param_docs={
+        "num_hidden": "Number of hidden units (output features).",
+        "no_bias": "Whether to disable the bias term.",
+        "flatten": "Whether to collapse all but the first axis of the input before the matmul.",
+        "act_dtype": "Activation strategy: int8 (dynamic activation quantization, native int8 matmul) | bf16 | float32 (dequant-on-load).",
+    },
+    no_grad_inputs=("weight", "scale"),
+)
+def _quantized_fully_connected(attrs, data, weight, scale, bias=None):
+    """FullyConnected over a per-channel-quantized int8/fp8 weight
+    (weight (O, I), scale (O,) — `mxnet_tpu.quant` PTQ output). Same
+    surface as FullyConnected with one extra `scale` input; the matmul
+    strategy is `ops.matrix.quantized_matmul`."""
+    from .matrix import quantized_matmul
+
+    if attrs["flatten"]:
+        x = data.reshape(data.shape[0], -1)
+    else:
+        x = data
+    out = quantized_matmul(x, weight, scale, attrs["act_dtype"])
+    if bias is not None:
+        out = out + bias
+    return out
+
+
 # --- Activation -------------------------------------------------------------
 @defop("Activation", arg_names=("data",), param_spec={"act_type": "relu"},
        param_docs={"act_type": "Element-wise nonlinearity: relu | sigmoid | tanh | softrelu | softsign | gelu | silu."})

@@ -245,6 +245,19 @@ class Predictor:
         p._compile()
         return p
 
+    def quantize(self, weight_dtype: str = "int8", act_dtype: str = "int8"):
+        """Post-training quantization: a :class:`~mxnet_tpu.quant.
+        QuantizedPredictor` over the same symbol and weights, with every
+        eligible FC/conv weight stored per-channel ``weight_dtype``
+        (int8 / fp8_e4m3) and scales passed as extra program arguments —
+        the progcache key stays weight-independent. The original
+        predictor is untouched."""
+        from . import quant as _quant
+
+        return _quant.quantize_predictor(
+            self, _quant.QuantConfig(weight_dtype=weight_dtype,
+                                     act_dtype=act_dtype))
+
     # --- serialized-executable export (amalgamation analogue) -------------
     def export(self, path: str):
         """Write a self-contained artifact: serialized StableHLO executable

@@ -476,7 +476,7 @@ def store(key: str, compiled, note: str = "", kind: str = "") -> bool:
     """Serialize ``compiled`` and commit it under ``key`` atomically,
     then update the manifest and evict past the byte budget. ``kind``
     classifies the entry (``predictor`` / ``train_step`` / ``fused`` /
-    ``decode``) for the per-kind byte accounting. Best-effort: returns
+    ``decode`` / ``quant``) for the per-kind byte accounting. Best-effort: returns
     False (never raises) when serialization or I/O fails — the caller
     already has its compiled program either way."""
     d = cache_dir()

@@ -1,0 +1,360 @@
+"""Post-training quantization (PTQ) for the inference surfaces.
+
+``mxnet_tpu.quant`` turns loaded f32 checkpoints into low-precision
+serving artifacts without retraining (ROADMAP "Quantized inference:
+int8/fp8 weights + low-precision KV"):
+
+- **Weights**: per-channel symmetric int8 or fp8-e4m3
+  (``quantize_params`` — the math is ``ops.contrib.quantize_symmetric``,
+  the same implementation behind the MXNet-parity ``contrib.quantize``
+  op). Quantized weights travel as program *arguments* next to their
+  ``<name>_scale`` arrays, exactly like ``DecodePrograms`` passes f32
+  params today — so progcache keys stay weight-independent and a warm
+  restart disk-loads quantized programs the same way it disk-loads f32
+  ones (entries are stored under ``kind="quant"``).
+- **Matmuls**: ``ops.matrix.quantized_matmul`` — either a native
+  int8×int8 ``dot_general`` with dynamic per-row activation
+  quantization (the MXU's double-rate int8 path; ``act_dtype="int8"``,
+  the default) or dequant-on-load into a bf16/f32 GEMM
+  (``act_dtype="bf16"``/``"float32"``; always used for fp8 weights).
+- **Models**: ``quantize_decode_model`` rewrites a ``DecodeModel``'s
+  projection/FFN/head weights in place of the f32 ones;
+  ``QuantizedPredictor`` is the fixed-shape serving twin — params become
+  program arguments (dequant-on-load inside the program, so XLA fuses
+  the scale multiply into the GEMM read).
+
+The default-OFF contract: nothing in this module runs unless a
+``MXNET_QUANT_*`` knob or an explicit config asks for it, and the f32
+paths it hooks are bitwise untouched when it is off (same pattern as
+``MXNET_DECODE_PAGED``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import predict as predict_mod
+from . import progcache
+from . import telemetry as _telemetry
+from .base import MXNetError
+from .ops.contrib import dequantize_symmetric, quantize_symmetric
+
+#: canonical weight formats -> element bytes
+WEIGHT_DTYPES = {"int8": 1, "fp8_e4m3": 1}
+#: canonical KV-cache dtypes -> element bytes
+KV_DTYPES = {"float32": 4, "bfloat16": 2, "int8": 1}
+
+_WEIGHT_ALIASES = {"int8": "int8", "fp8": "fp8_e4m3", "fp8_e4m3": "fp8_e4m3",
+                   "float8_e4m3": "fp8_e4m3"}
+_ACT_ALIASES = {"int8": "int8", "bf16": "bf16", "bfloat16": "bf16",
+                "f32": "float32", "fp32": "float32", "float32": "float32"}
+_KV_ALIASES = {"f32": "float32", "fp32": "float32", "float32": "float32",
+               "bf16": "bfloat16", "bfloat16": "bfloat16", "int8": "int8"}
+
+
+def normalize_weight_dtype(name: str) -> str:
+    try:
+        return _WEIGHT_ALIASES[str(name).strip().lower()]
+    except KeyError:
+        raise MXNetError(
+            "MXNET_QUANT_WEIGHT_DTYPE must be one of %s, got %r"
+            % (sorted(set(_WEIGHT_ALIASES)), name))
+
+
+def normalize_kv_dtype(name: str) -> str:
+    """Canonicalize an ``MXNET_DECODE_KV_DTYPE`` spelling
+    (f32|bf16|int8, long forms accepted)."""
+    try:
+        return _KV_ALIASES[str(name).strip().lower()]
+    except KeyError:
+        raise MXNetError(
+            "MXNET_DECODE_KV_DTYPE must be one of %s, got %r"
+            % (sorted(set(_KV_ALIASES)), name))
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantConfig:
+    """Weight-quantization knobs (``MXNET_QUANT_*`` env defaults read at
+    construction, docs/env_var.md).
+
+    ``weight_dtype``: int8 | fp8_e4m3. ``act_dtype`` selects the matmul
+    strategy for int8 weights: "int8" (default — dynamic activation
+    quantization + native int8 matmul) or "bf16"/"float32"
+    (dequant-on-load). fp8 weights always run dequant-on-load.
+    """
+    weight_dtype: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "MXNET_QUANT_WEIGHT_DTYPE", "int8"))
+    act_dtype: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "MXNET_QUANT_ACT_DTYPE", "int8"))
+
+    def __post_init__(self):
+        object.__setattr__(self, "weight_dtype",
+                           normalize_weight_dtype(self.weight_dtype))
+        act = str(self.act_dtype).strip().lower()
+        if act not in _ACT_ALIASES:
+            raise MXNetError(
+                "MXNET_QUANT_ACT_DTYPE must be one of %s, got %r"
+                % (sorted(set(_ACT_ALIASES)), self.act_dtype))
+        object.__setattr__(self, "act_dtype", _ACT_ALIASES[act])
+
+
+# --- telemetry (quant_params_bytes{dtype=...}, docs/observability.md) ------
+_bytes_lock = threading.Lock()
+_params_bytes: Dict[str, int] = {}
+
+
+def _account_params_bytes(dtype: str, nbytes: int):
+    with _bytes_lock:
+        _params_bytes[dtype] = _params_bytes.get(dtype, 0) + int(nbytes)
+        total = _params_bytes[dtype]
+    _telemetry.registry.gauge(
+        "quant_params_bytes", labels={"dtype": dtype},
+        help="bytes held in quantized weight arrays, by target dtype"
+    ).set(total)
+
+
+def quant_params_bytes() -> Dict[str, int]:
+    """Quantized-weight bytes accounted so far, by target dtype."""
+    with _bytes_lock:
+        return dict(_params_bytes)
+
+
+# --- the PTQ pass ----------------------------------------------------------
+def quantize_weight(w, weight_dtype: str = "int8", axis=0):
+    """Per-channel symmetric quantization of one weight array. Returns
+    ``(q, scale)`` with ``scale`` squeezed to the kept channel axes
+    (e.g. (O, I) -> scale (O,); stacked (L, O, I) -> (L, O)). One math
+    implementation: ``ops.contrib.quantize_symmetric``."""
+    weight_dtype = normalize_weight_dtype(weight_dtype)
+    q, scale = quantize_symmetric(jnp.asarray(w), weight_dtype, axis=axis)
+    keep = sorted({a % q.ndim for a in
+                   (axis if isinstance(axis, (tuple, list)) else (axis,))})
+    return q, scale.reshape(tuple(q.shape[a] for a in keep))
+
+
+def dequantize_weight(q, scale):
+    """Widen a quantized weight back to f32: inverse of
+    :func:`quantize_weight` (scale re-broadcast over the reduced axes —
+    channel axes are assumed LEADING, the (L?, O, I) layouts used
+    here)."""
+    s = jnp.asarray(scale)
+    s = s.reshape(s.shape + (1,) * (q.ndim - s.ndim))
+    return dequantize_symmetric(q, s)
+
+
+#: DecodeModel matmul weights the PTQ pass rewrites, with their channel
+#: axes ((L, O, I) stacked -> (0, 1); flat (O, I) -> 0). embed stays f32
+#: (it is a gather table, not a GEMM operand); norms/biases are tiny.
+DECODE_QUANT_WEIGHTS = {
+    "wq": (0, 1), "wk": (0, 1), "wv": (0, 1), "wo": (0, 1),
+    "w1": (0, 1), "w2": (0, 1), "pred_w": 0,
+}
+
+
+def quantize_params(params: Dict[str, jnp.ndarray], names_axes: Dict,
+                    weight_dtype: str = "int8") -> Dict[str, jnp.ndarray]:
+    """Quantize ``names_axes`` entries of a param dict, returning a new
+    dict where each quantized ``name`` is joined by ``name_scale`` —
+    scales ride as sibling *arguments*, never closure constants, so
+    program cache keys stay weight-independent."""
+    weight_dtype = normalize_weight_dtype(weight_dtype)
+    out = dict(params)
+    qbytes = 0
+    for name, axis in names_axes.items():
+        if name not in params:
+            raise MXNetError("quantize_params: no param %r" % name)
+        q, scale = quantize_weight(params[name], weight_dtype, axis)
+        out[name] = q
+        out[name + "_scale"] = scale
+        qbytes += int(np.prod(q.shape)) * WEIGHT_DTYPES[weight_dtype]
+    _account_params_bytes(weight_dtype, qbytes)
+    return out
+
+
+def quantize_decode_model(model, config: Optional[QuantConfig] = None):
+    """PTQ over a ``DecodeModel``: projection/FFN/head weights become
+    int8/fp8 program arguments with per-channel scales; the returned
+    model builds programs whose matmuls route through
+    ``ops.matrix.quantized_matmul`` (``config.act_dtype`` strategy)."""
+    from .serving.generate.model import DecodeModel
+
+    config = config or QuantConfig()
+    params = quantize_params(model.params, DECODE_QUANT_WEIGHTS,
+                             config.weight_dtype)
+    qm = DecodeModel(params, model.spec)
+    qm.quant_act = config.act_dtype
+    return qm
+
+
+# --- quantized fixed-shape predictor ---------------------------------------
+def quantizable_weights(symbol) -> List[str]:
+    """Names of weight params feeding FullyConnected/Convolution weight
+    slots — the GEMM operands worth quantizing. Channel axis is 0 (the
+    (O, I...) orientation both ops use)."""
+    names = []
+    for node in symbol._nodes():
+        if node.is_var or node.op.name not in ("FullyConnected",
+                                               "Convolution"):
+            continue
+        if len(node.inputs) > 1:
+            child, _idx = node.inputs[1]
+            if child.is_var and child.name not in names:
+                names.append(child.name)
+    return names
+
+
+class QuantizedPredictor(predict_mod.Predictor):
+    """Predictor twin whose params are program ARGUMENTS (quantized
+    weights + scales), not closure constants.
+
+    The compiled program dequantizes each weight on load (the scale
+    multiply fuses into the GEMM read), so accuracy tracks per-channel
+    PTQ while weight bytes shrink 4x (int8/fp8). Because weights are
+    arguments, the progcache key comes from the LOWERED StableHLO text —
+    weight-independent, like ``DecodePrograms`` — and entries are stored
+    under ``kind="quant"``.
+    """
+
+    def __init__(self, symbol_json: str, params,
+                 input_shapes: Dict[str, tuple], dtype="float32",
+                 device=None, qconfig: Optional[QuantConfig] = None):
+        self._qconfig = qconfig or QuantConfig()
+        super().__init__(symbol_json, params, input_shapes, dtype, device)
+
+    def _quantize_params(self):
+        """name -> f32 array | (q, scale) for every arg param, built once
+        and shared across reshapes (the BucketCache ladder)."""
+        qnames = set(quantizable_weights(self._symbol))
+        qvals: Dict[str, object] = {}
+        qbytes = 0
+        for n, a in self._arg_params.items():
+            w = a._data
+            if n in qnames and w.ndim >= 2:
+                q, scale = quantize_weight(
+                    w, self._qconfig.weight_dtype, axis=0)
+                qvals[n] = {"q": q, "scale": scale}
+                qbytes += int(np.prod(q.shape)) * \
+                    WEIGHT_DTYPES[self._qconfig.weight_dtype]
+            else:
+                qvals[n] = w
+        _account_params_bytes(self._qconfig.weight_dtype, qbytes)
+        return qvals
+
+    def _compile(self):
+        if not hasattr(self, "_qvals"):
+            self._qvals = self._quantize_params()
+        eval_fn = self._symbol.build_eval()
+        input_names = self._input_names
+
+        def fwd(qparams, aux_vals, *input_arrays):
+            args = {}
+            for n, v in qparams.items():
+                if isinstance(v, dict):
+                    # dequant-on-load: XLA fuses the widen+scale into the
+                    # consuming GEMM's operand read
+                    args[n] = dequantize_weight(v["q"], v["scale"])
+                else:
+                    args[n] = v
+            args.update(dict(zip(input_names, input_arrays)))
+            outs, _ = eval_fn(args, aux_vals, False, jax.random.PRNGKey(0))
+            return tuple(outs)
+
+        self._aux_vals = {n: a._data for n, a in self._aux_params.items()}
+        self._jitted = jax.jit(fwd)
+        aval = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)  # noqa: E731
+        qp_avals = jax.tree_util.tree_map(aval, self._qvals)
+        aux_avals = jax.tree_util.tree_map(aval, self._aux_vals)
+        in_specs = [jax.ShapeDtypeStruct(self._input_shapes[n],
+                                         jnp.dtype(self._dtype))
+                    for n in input_names]
+        with self._device_scope():
+            self._lowered = self._jitted.lower(qp_avals, aux_avals,
+                                               *in_specs)
+            cache_key = None
+            if progcache.enabled():
+                cache_key = progcache.lowered_key(
+                    self._lowered.as_text(), donate=(),
+                    extra="quant_predictor:%s:%s"
+                    % (self._qconfig.weight_dtype, self._qconfig.act_dtype))
+                loaded = progcache.load(cache_key)
+                if loaded is not None:
+                    self._exec = loaded
+                    self.progcache_source = "disk"
+                    predict_mod._DISK_LOAD_COUNT += 1
+                    return
+            self._exec = self._lowered.compile()
+        predict_mod._COMPILE_COUNT += 1
+        self.progcache_source = "compile"
+        if cache_key is not None:
+            progcache.store(cache_key, self._exec, note="quant_predictor",
+                            kind="quant")
+
+    def forward(self, **inputs):
+        """MXPredForward over the argument-passing program (params +
+        scales are leading args; same locking contract as Predictor)."""
+        with self._run_lock:
+            for k, v in inputs.items():
+                self.set_input(k, v)
+            vals = []
+            for n in self._input_names:
+                if self._inputs[n] is None:
+                    raise MXNetError("input %r not set" % n)
+                vals.append(
+                    self._inputs[n]._data.astype(jnp.dtype(self._dtype)))
+        with self._device_scope():
+            if self._device is not None:
+                vals = [jax.device_put(v, self._device) for v in vals]
+            outs = self._exec(self._qvals, self._aux_vals, *vals)
+        result = [predict_mod.NDArray(o) for o in outs]
+        with self._run_lock:
+            self._outputs = result
+        return result
+
+    def reshape(self, new_input_shapes: Dict[str, tuple],
+                device=None) -> "QuantizedPredictor":
+        """MXPredReshape sharing weights AND their quantization — the
+        BucketCache ladder quantizes once, not once per bucket."""
+        p = QuantizedPredictor.__new__(QuantizedPredictor)
+        p._symbol = self._symbol
+        p._arg_params = self._arg_params
+        p._aux_params = self._aux_params
+        p._input_names = list(new_input_shapes)
+        p._input_shapes = {k: tuple(v) for k, v in new_input_shapes.items()}
+        p._dtype = self._dtype
+        p._device = device if device is not None else self._device
+        p._inputs = {n: None for n in p._input_shapes}
+        p._outputs = []
+        p._run_lock = threading.RLock()
+        p._qconfig = self._qconfig
+        p._qvals = self._qvals
+        fp = getattr(self, "_progcache_model_fp", None)
+        if fp is not None:
+            p._progcache_model_fp = fp
+        p._compile()
+        return p
+
+    def export(self, path: str):
+        raise MXNetError(
+            "QuantizedPredictor.export is not supported — export the f32 "
+            "Predictor and quantize at load time instead")
+
+
+def quantize_predictor(predictor: predict_mod.Predictor,
+                       config: Optional[QuantConfig] = None
+                       ) -> QuantizedPredictor:
+    """PTQ over an existing Predictor: rebind the same symbol/params as a
+    :class:`QuantizedPredictor` at the same shapes/device."""
+    return QuantizedPredictor(
+        predictor._symbol.tojson(),
+        {n: a for n, a in predictor._arg_params.items()} |
+        {"aux:%s" % n: a for n, a in predictor._aux_params.items()},
+        predictor._input_shapes, dtype=predictor._dtype,
+        device=predictor._device, qconfig=config)

@@ -56,6 +56,10 @@ class KVCacheManager:
         self.var = _engine.new_variable()
         _engine.track_inflight(self.var)
         self.k_slab, self.v_slab = programs.fresh_slabs()
+        # int8 KV: per-position f32 scale slabs travel with the value
+        # slabs through every program (same engine var, same donation)
+        scales = programs.fresh_scale_slabs()
+        self.k_scale, self.v_scale = scales if scales else (None, None)
         self._lock = threading.Lock()
         # host mirrors: lengths[i] = tokens materialized in row i's kv
         # (prompt + generated so far); owner[i] = opaque sequence tag
@@ -132,9 +136,12 @@ class KVCacheManager:
         return lengths, mask
 
     # --- slab plumbing (scheduler thread only) ---------------------------
-    def swap_slabs(self, k_slab, v_slab):
-        """Adopt the donated-output slabs a step/admit program returned."""
+    def swap_slabs(self, k_slab, v_slab, k_scale=None, v_scale=None):
+        """Adopt the donated-output slabs a step/admit program returned
+        (int8 KV programs also return the scale slabs)."""
         self.k_slab, self.v_slab = k_slab, v_slab
+        if k_scale is not None:
+            self.k_scale, self.v_scale = k_scale, v_scale
 
     def reset(self):
         """Fresh slabs + empty bookkeeping (server restart)."""
@@ -143,6 +150,8 @@ class KVCacheManager:
             self._owner = [None] * self.slots
             self._free_slots = deque(range(self.slots))
         self.k_slab, self.v_slab = self.programs.fresh_slabs()
+        scales = self.programs.fresh_scale_slabs()
+        self.k_scale, self.v_scale = scales if scales else (None, None)
 
     def kv_bytes(self) -> int:
         return self.programs.kv_bytes()

@@ -37,7 +37,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...ops.attention import cached_attention, prefix_cached_attention, rope
+from ...ops.matrix import quantized_matmul
 from ..batcher import ServingError
+
+#: MXNET_DECODE_KV_DTYPE -> slab element type (scales, int8 only, ride in
+#: separate f32 slabs — see kv_scale_slab_shape)
+KV_SLAB_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+                  "int8": jnp.int8}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,6 +66,36 @@ def _ln(x, g, b, eps=1e-5):
     return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
 
 
+def _mm(params, x, name, l=None, act="int8"):
+    """``x @ W.T`` with ``W = params[name]`` (``[l]`` when stacked).
+
+    When ``mxnet_tpu.quant`` has rewritten this weight, a sibling
+    ``<name>_scale`` entry exists and the matmul routes through
+    ``ops.matrix.quantized_matmul`` (``act`` selects native-int8 vs
+    dequant-on-load). With no scale entry this emits the exact
+    pre-quantization expression — the quant-OFF jaxpr, and therefore the
+    compiled program and its streams, are bitwise unchanged."""
+    w = params[name] if l is None else params[name][l]
+    sname = name + "_scale"
+    if sname in params:
+        s = params[sname] if l is None else params[sname][l]
+        return quantized_matmul(x, w, s, act_dtype=act)
+    return x @ w.T
+
+
+def _quantize_kv(x):
+    """Per-position symmetric int8 over the (Hkv, Dh) axes:
+    ``x (..., Hkv, t, Dh) -> (q int8 same shape, scale (..., t) f32)``.
+    Each cache position is written exactly once, so one scale per
+    position never needs requantization — CoW forks copy scale rows
+    alongside value blocks (ops.attention.dequantize_kv is the read-side
+    inverse)."""
+    amax = jnp.max(jnp.abs(x), axis=(-3, -1))
+    scale = jnp.maximum(amax, 1e-12).astype(jnp.float32) / 127.0
+    q = jnp.clip(jnp.round(x / scale[..., None, :, None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
 class DecodeModel:
     """Canonical stacked decoder-LM weights + derived dims.
 
@@ -73,6 +109,9 @@ class DecodeModel:
     def __init__(self, params: Dict[str, jnp.ndarray], spec: DecodeSpec):
         self.params = params
         self.spec = spec
+        # matmul strategy when params carry quantized weights (set by
+        # mxnet_tpu.quant.quantize_decode_model); inert without them
+        self.quant_act = "int8"
         self.vocab, self.dm = params["embed"].shape
         self.layers = params["wq"].shape[0]
         self.dff = params["w1"].shape[1]
@@ -137,6 +176,11 @@ class DecodeModel:
         """(L, slots, Hkv, C, Dh) — one of the two per-replica slabs."""
         return (self.layers, slots, self.spec.hkv, capacity, self.head_dim)
 
+    def kv_scale_slab_shape(self, slots: int, capacity: int) -> tuple:
+        """(L, slots, C) — per-position f32 scales for an int8 KV slab
+        (one scale per cached position, shared across Hkv and Dh)."""
+        return (self.layers, slots, capacity)
+
     def fingerprint_items(self):
         """(name, array) pairs in stable order, for the progcache model
         fingerprint (weights are program ARGS here, but the fingerprint
@@ -148,9 +192,10 @@ class DecodeModel:
         """q/k/v projections of (b, t, D) -> split-head (b, {H|Hkv}, t, Dh),
         roped later (rope needs absolute positions)."""
         p, s = self.params, self.spec
-        q = h @ p["wq"][l].T
-        k = h @ p["wk"][l].T
-        v = h @ p["wv"][l].T
+        act = getattr(self, "quant_act", "int8")
+        q = _mm(p, h, "wq", l, act)
+        k = _mm(p, h, "wk", l, act)
+        v = _mm(p, h, "wv", l, act)
         q = q.reshape(b, t, s.num_heads, self.head_dim).transpose(0, 2, 1, 3)
         k = k.reshape(b, t, s.hkv, self.head_dim).transpose(0, 2, 1, 3)
         v = v.reshape(b, t, s.hkv, self.head_dim).transpose(0, 2, 1, 3)
@@ -158,30 +203,40 @@ class DecodeModel:
 
     def _mlp(self, x, l):
         p = self.params
+        act = getattr(self, "quant_act", "int8")
         h = _ln(x, p["ln2_g"][l], p["ln2_b"][l])
-        h = jax.nn.gelu(h @ p["w1"][l].T + p["b1"][l])
-        return x + (h @ p["w2"][l].T + p["b2"][l])
+        h = jax.nn.gelu(_mm(p, h, "w1", l, act) + p["b1"][l])
+        return x + (_mm(p, h, "w2", l, act) + p["b2"][l])
 
     def _head(self, x):
         p = self.params
+        act = getattr(self, "quant_act", "int8")
         x = _ln(x, p["lnf_g"], p["lnf_b"])
-        return x @ p["pred_w"].T + p["pred_b"]
+        return _mm(p, x, "pred_w", None, act) + p["pred_b"]
 
-    def build_prefill(self, bucket: int, capacity: int):
+    def build_prefill(self, bucket: int, capacity: int,
+                      kv_dtype: str = "float32"):
         """Pure fn (params, tokens (1, T=bucket) i32, length (1,) i32) ->
         (logits (1, V) f32, k (L, 1, Hkv, C, Dh), v (...)). Padded
         positions >= length produce garbage kv that decode never reads
         (masked by length); the causal mask keeps them out of the
-        returned last-real-position logits."""
+        returned last-real-position logits.
+
+        ``kv_dtype`` re-types the RETURNED cache only (in-band prefill
+        attention stays full precision — only stored state narrows):
+        bf16 casts; int8 quantizes per position and appends (L, 1, C)
+        k/v scale arrays to the outputs."""
         if bucket > capacity:
             raise ServingError("prefill bucket %d exceeds kv capacity %d"
                                % (bucket, capacity))
         spec = self.spec
+        act = getattr(self, "quant_act", "int8")
 
         def prefill(params, tokens, length):
             self_p = DecodeModel.__new__(DecodeModel)
             self_p.params = params
             self_p.spec = spec
+            self_p.quant_act = act
             self_p.vocab, self_p.dm = params["embed"].shape
             self_p.layers = params["wq"].shape[0]
             self_p.head_dim = self_p.dm // spec.num_heads
@@ -198,7 +253,7 @@ class DecodeModel:
                 from ...ops.pallas import flash_attention as _fa
                 att = _fa.flash_attention(q, k, v, causal=True)
                 att = att.transpose(0, 2, 1, 3).reshape(1, bucket, self_p.dm)
-                x = x + att @ params["wo"][l].T
+                x = x + _mm(params, att, "wo", l, act)
                 x = self_p._mlp(x, l)
                 ks.append(k)
                 vs.append(v)
@@ -209,19 +264,38 @@ class DecodeModel:
             pad = ((0, 0), (0, 0), (0, 0), (0, capacity - bucket), (0, 0))
             k_out = jnp.pad(jnp.stack(ks), pad)   # (L, 1, Hkv, C, Dh)
             v_out = jnp.pad(jnp.stack(vs), pad)
+            if kv_dtype == "int8":
+                kq, k_s = _quantize_kv(k_out)     # scales (L, 1, C)
+                vq, v_s = _quantize_kv(v_out)
+                return last, kq, vq, k_s, v_s
+            if kv_dtype == "bfloat16":
+                return (last, k_out.astype(jnp.bfloat16),
+                        v_out.astype(jnp.bfloat16))
             return last, k_out, v_out
 
         return prefill
 
-    def build_decode(self, slots: int, capacity: int):
+    def build_decode(self, slots: int, capacity: int,
+                     kv_dtype: str = "float32"):
         """Pure fn (params, k_slab, v_slab, lengths (B,) i32, tokens (B,)
         i32) -> (logits (B, V), k_slab, v_slab). Slabs are meant to be
         donated by the compiler wrapper: steady state rewrites C-slices in
         place and allocates only the (B, V) logits. Inactive slots run
-        with lengths pinned to 0 — wasted lanes, never wrong lanes."""
-        spec = self.spec
+        with lengths pinned to 0 — wasted lanes, never wrong lanes.
 
-        def decode(params, k_slab, v_slab, lengths, tokens):
+        ``kv_dtype``: bf16 re-types the slabs (writes cast, reads flow
+        through the f32-accumulating einsum). int8 inserts f32 scale
+        slabs (L, B, C) into the signature — (params, k_slab, v_slab,
+        ks_slab, vs_slab, lengths, tokens) -> (logits, k, v, ks, vs) —
+        quantizing each new position BEFORE attention reads the slab, so
+        a token's own step sees exactly the values every later step sees.
+        f32 keeps the historical jaxpr bitwise (the astype below folds
+        away)."""
+        spec = self.spec
+        act = getattr(self, "quant_act", "int8")
+
+        def body(params, k_slab, v_slab, ks_slab, vs_slab, lengths,
+                 tokens):
             dm = params["embed"].shape[1]
             n_layers = params["wq"].shape[0]
             head_dim = dm // spec.num_heads
@@ -231,11 +305,11 @@ class DecodeModel:
             pos = lengths.reshape(slots, 1, 1)
             for l in range(n_layers):
                 h = _ln(x, params["ln1_g"][l], params["ln1_b"][l])
-                q = (h @ params["wq"][l].T).reshape(
+                q = _mm(params, h, "wq", l, act).reshape(
                     slots, spec.num_heads, 1, head_dim)
-                k_t = (h @ params["wk"][l].T).reshape(
+                k_t = _mm(params, h, "wk", l, act).reshape(
                     slots, spec.hkv, 1, head_dim)
-                v_t = (h @ params["wv"][l].T).reshape(
+                v_t = _mm(params, h, "wv", l, act).reshape(
                     slots, spec.hkv, 1, head_dim)
                 q = rope(q, positions=pos, base=spec.rope_base)
                 k_t = rope(k_t, positions=pos, base=spec.rope_base)
@@ -245,19 +319,53 @@ class DecodeModel:
                     # at its own position p = lengths[i]
                     return jax.lax.dynamic_update_slice(cache, new, (0, p, 0))
 
-                k_l = jax.vmap(write)(k_slab[l], k_t, lengths)
-                v_l = jax.vmap(write)(v_slab[l], v_t, lengths)
-                k_slab = k_slab.at[l].set(k_l)
-                v_slab = v_slab.at[l].set(v_l)
-                att = cached_attention(q, k_l, v_l, lengths)
+                if ks_slab is None:
+                    k_l = jax.vmap(write)(k_slab[l],
+                                          k_t.astype(k_slab.dtype), lengths)
+                    v_l = jax.vmap(write)(v_slab[l],
+                                          v_t.astype(v_slab.dtype), lengths)
+                    k_slab = k_slab.at[l].set(k_l)
+                    v_slab = v_slab.at[l].set(v_l)
+                    att = cached_attention(q, k_l, v_l, lengths)
+                else:
+                    kq, k_s = _quantize_kv(k_t)   # scales (B, 1)
+                    vq, v_s = _quantize_kv(v_t)
+                    k_l = jax.vmap(write)(k_slab[l], kq, lengths)
+                    v_l = jax.vmap(write)(v_slab[l], vq, lengths)
+
+                    def write_s(row, new, p):
+                        # row (C,), new (1,): scale lands beside its value
+                        return jax.lax.dynamic_update_slice(row, new, (p,))
+
+                    ks_l = jax.vmap(write_s)(ks_slab[l], k_s, lengths)
+                    vs_l = jax.vmap(write_s)(vs_slab[l], v_s, lengths)
+                    k_slab = k_slab.at[l].set(k_l)
+                    v_slab = v_slab.at[l].set(v_l)
+                    ks_slab = ks_slab.at[l].set(ks_l)
+                    vs_slab = vs_slab.at[l].set(vs_l)
+                    att = cached_attention(q, k_l, v_l, lengths,
+                                           k_scale=ks_l, v_scale=vs_l)
                 att = att.transpose(0, 2, 1, 3).reshape(slots, dm)
-                x = x + att @ params["wo"][l].T
+                x = x + _mm(params, att, "wo", l, act)
                 h2 = _ln(x, params["ln2_g"][l], params["ln2_b"][l])
-                h2 = jax.nn.gelu(h2 @ params["w1"][l].T + params["b1"][l])
-                x = x + (h2 @ params["w2"][l].T + params["b2"][l])
-            logits = _ln(x, params["lnf_g"], params["lnf_b"]) \
-                @ params["pred_w"].T + params["pred_b"]
-            return logits, k_slab, v_slab
+                h2 = jax.nn.gelu(_mm(params, h2, "w1", l, act)
+                                 + params["b1"][l])
+                x = x + (_mm(params, h2, "w2", l, act) + params["b2"][l])
+            logits = _mm(params, _ln(x, params["lnf_g"], params["lnf_b"]),
+                         "pred_w", None, act) + params["pred_b"]
+            if ks_slab is None:
+                return logits, k_slab, v_slab
+            return logits, k_slab, v_slab, ks_slab, vs_slab
+
+        if kv_dtype == "int8":
+            def decode(params, k_slab, v_slab, ks_slab, vs_slab, lengths,
+                       tokens):
+                return body(params, k_slab, v_slab, ks_slab, vs_slab,
+                            lengths, tokens)
+        else:
+            def decode(params, k_slab, v_slab, lengths, tokens):
+                return body(params, k_slab, v_slab, None, None, lengths,
+                            tokens)
 
         return decode
 
@@ -268,8 +376,14 @@ class DecodeModel:
         return (self.layers, num_blocks, self.spec.hkv, block_tokens,
                 self.head_dim)
 
+    def paged_scale_slab_shape(self, num_blocks: int,
+                               block_tokens: int) -> tuple:
+        """(L, num_blocks, T) — per-position f32 scales for an int8 paged
+        slab (block 0 included, same trash-block discipline)."""
+        return (self.layers, num_blocks, block_tokens)
+
     def build_paged_prefill(self, bucket: int, block_tokens: int,
-                            max_blocks: int):
+                            max_blocks: int, kv_dtype: str = "float32"):
         """Pure fn (params, k_slab, v_slab, table (MB,) i32, ctx_len ()
         i32, tokens (1, T=bucket) i32, n (1,) i32, fork_src () i32,
         fork_dst () i32) -> (logits (1, V), k_slab, v_slab).
@@ -291,17 +405,26 @@ class DecodeModel:
         3. **Admit**: each suffix position's k/v is scattered to physical
            block ``table[(ctx_len + j) // T]`` offset ``(ctx_len + j) % T``
            (padded positions j >= n go to trash block 0).
+
+        int8 ``kv_dtype`` adds scale slabs right after the value slabs
+        (same donation discipline): (params, k_slab, v_slab, ks_slab,
+        vs_slab, table, ...) -> (logits, k, v, ks, vs). The CoW fork
+        copies scale blocks alongside value blocks, the prefix gather
+        widens through the per-position scales, and the suffix scatter
+        stores freshly quantized positions + their scales.
         """
         spec = self.spec
+        act = getattr(self, "quant_act", "int8")
         T = int(block_tokens)
         mb = int(max_blocks)
         cap = T * mb
 
-        def prefill(params, k_slab, v_slab, table, ctx_len, tokens, n,
-                    fork_src, fork_dst):
+        def body(params, k_slab, v_slab, ks_slab, vs_slab, table, ctx_len,
+                 tokens, n, fork_src, fork_dst):
             self_p = DecodeModel.__new__(DecodeModel)
             self_p.params = params
             self_p.spec = spec
+            self_p.quant_act = act
             self_p.vocab, self_p.dm = params["embed"].shape
             self_p.layers = params["wq"].shape[0]
             self_p.head_dim = self_p.dm // spec.num_heads
@@ -313,6 +436,9 @@ class DecodeModel:
             # entry already names fork_dst).
             k_slab = k_slab.at[:, fork_dst].set(k_slab[:, fork_src])
             v_slab = v_slab.at[:, fork_dst].set(v_slab[:, fork_src])
+            if ks_slab is not None:
+                ks_slab = ks_slab.at[:, fork_dst].set(ks_slab[:, fork_src])
+                vs_slab = vs_slab.at[:, fork_dst].set(vs_slab[:, fork_src])
             x = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
             j = jnp.arange(bucket, dtype=jnp.int32)
             pos = ctx_len + j                       # absolute positions
@@ -331,27 +457,57 @@ class DecodeModel:
                     .reshape(1, hkv, cap, self_p.head_dim)
                 v_ctx = v_slab[l][table].transpose(1, 0, 2, 3) \
                     .reshape(1, hkv, cap, self_p.head_dim)
-                att = prefix_cached_attention(q, k_ctx, v_ctx, ctx_len,
-                                              k, v)
+                if ks_slab is None:
+                    att = prefix_cached_attention(q, k_ctx, v_ctx, ctx_len,
+                                                  k, v)
+                else:
+                    k_sctx = ks_slab[l][table].reshape(1, cap)
+                    v_sctx = vs_slab[l][table].reshape(1, cap)
+                    att = prefix_cached_attention(q, k_ctx, v_ctx, ctx_len,
+                                                  k, v, k_scale=k_sctx,
+                                                  v_scale=v_sctx)
                 att = att.transpose(0, 2, 1, 3).reshape(1, bucket,
                                                         self_p.dm)
-                x = x + att @ params["wo"][l].T
+                x = x + _mm(params, att, "wo", l, act)
                 x = self_p._mlp(x, l)
                 # (3) admit: scatter this layer's suffix k/v into place
-                k_slab = k_slab.at[l, phys, :, off, :].set(
-                    k[0].transpose(1, 0, 2))
-                v_slab = v_slab.at[l, phys, :, off, :].set(
-                    v[0].transpose(1, 0, 2))
+                if ks_slab is None:
+                    k_slab = k_slab.at[l, phys, :, off, :].set(
+                        k[0].transpose(1, 0, 2).astype(k_slab.dtype))
+                    v_slab = v_slab.at[l, phys, :, off, :].set(
+                        v[0].transpose(1, 0, 2).astype(v_slab.dtype))
+                else:
+                    kq, k_s = _quantize_kv(k)     # scales (1, bucket)
+                    vq, v_s = _quantize_kv(v)
+                    k_slab = k_slab.at[l, phys, :, off, :].set(
+                        kq[0].transpose(1, 0, 2))
+                    v_slab = v_slab.at[l, phys, :, off, :].set(
+                        vq[0].transpose(1, 0, 2))
+                    ks_slab = ks_slab.at[l, phys, off].set(k_s[0])
+                    vs_slab = vs_slab.at[l, phys, off].set(v_s[0])
             logits = self_p._head(x)  # (1, T, V)
             last = jnp.take_along_axis(
                 logits, (n - 1).astype(jnp.int32)[:, None, None], axis=1
             )[:, 0, :]
-            return last, k_slab, v_slab
+            if ks_slab is None:
+                return last, k_slab, v_slab
+            return last, k_slab, v_slab, ks_slab, vs_slab
+
+        if kv_dtype == "int8":
+            def prefill(params, k_slab, v_slab, ks_slab, vs_slab, table,
+                        ctx_len, tokens, n, fork_src, fork_dst):
+                return body(params, k_slab, v_slab, ks_slab, vs_slab,
+                            table, ctx_len, tokens, n, fork_src, fork_dst)
+        else:
+            def prefill(params, k_slab, v_slab, table, ctx_len, tokens, n,
+                        fork_src, fork_dst):
+                return body(params, k_slab, v_slab, None, None, table,
+                            ctx_len, tokens, n, fork_src, fork_dst)
 
         return prefill
 
     def build_paged_decode(self, slots: int, block_tokens: int,
-                           max_blocks: int):
+                           max_blocks: int, kv_dtype: str = "float32"):
         """Pure fn (params, k_slab, v_slab, tables (B, MB) i32, lengths
         (B,) i32, tokens (B,) i32) -> (logits (B, V), k_slab, v_slab).
 
@@ -364,13 +520,20 @@ class DecodeModel:
         like the unpaged step. Inactive lanes carry an all-zero table, so
         their writes land in trash block 0 — wasted lanes, never wrong
         lanes, same fixed-shape discipline as the unpaged program.
+
+        int8 ``kv_dtype`` adds scale slabs (L, NB, T) after the value
+        slabs, written at the same (phys_w, off_w) site and gathered
+        per row as (B, C) for the widening read — see ``build_decode``
+        for the read-your-own-write ordering argument.
         """
         spec = self.spec
+        act = getattr(self, "quant_act", "int8")
         T = int(block_tokens)
         mb = int(max_blocks)
         cap = T * mb
 
-        def decode(params, k_slab, v_slab, tables, lengths, tokens):
+        def body(params, k_slab, v_slab, ks_slab, vs_slab, tables,
+                 lengths, tokens):
             dm = params["embed"].shape[1]
             n_layers = params["wq"].shape[0]
             head_dim = dm // spec.num_heads
@@ -386,40 +549,87 @@ class DecodeModel:
             off_w = lengths % T
             for l in range(n_layers):
                 h = _ln(x, params["ln1_g"][l], params["ln1_b"][l])
-                q = (h @ params["wq"][l].T).reshape(
+                q = _mm(params, h, "wq", l, act).reshape(
                     slots, spec.num_heads, 1, head_dim)
-                k_t = (h @ params["wk"][l].T).reshape(
+                k_t = _mm(params, h, "wk", l, act).reshape(
                     slots, hkv, 1, head_dim)
-                v_t = (h @ params["wv"][l].T).reshape(
+                v_t = _mm(params, h, "wv", l, act).reshape(
                     slots, hkv, 1, head_dim)
                 q = rope(q, positions=pos, base=spec.rope_base)
                 k_t = rope(k_t, positions=pos, base=spec.rope_base)
-                k_slab = k_slab.at[l, phys_w, :, off_w, :].set(
-                    k_t[:, :, 0, :])
-                v_slab = v_slab.at[l, phys_w, :, off_w, :].set(
-                    v_t[:, :, 0, :])
+                if ks_slab is not None:
+                    kq, k_s = _quantize_kv(k_t)   # scales (B, 1)
+                    vq, v_s = _quantize_kv(v_t)
+                    k_slab = k_slab.at[l, phys_w, :, off_w, :].set(
+                        kq[:, :, 0, :])
+                    v_slab = v_slab.at[l, phys_w, :, off_w, :].set(
+                        vq[:, :, 0, :])
+                    ks_slab = ks_slab.at[l, phys_w, off_w].set(k_s[:, 0])
+                    vs_slab = vs_slab.at[l, phys_w, off_w].set(v_s[:, 0])
+                else:
+                    k_slab = k_slab.at[l, phys_w, :, off_w, :].set(
+                        k_t[:, :, 0, :].astype(k_slab.dtype))
+                    v_slab = v_slab.at[l, phys_w, :, off_w, :].set(
+                        v_t[:, :, 0, :].astype(v_slab.dtype))
                 # gather each row's dense view (write first, so the new
                 # token's k/v is visible to its own attention)
                 k_l = k_slab[l][tables].transpose(0, 2, 1, 3, 4) \
                     .reshape(slots, hkv, cap, head_dim)
                 v_l = v_slab[l][tables].transpose(0, 2, 1, 3, 4) \
                     .reshape(slots, hkv, cap, head_dim)
-                att = cached_attention(q, k_l, v_l, lengths)
+                if ks_slab is not None:
+                    ks_l = ks_slab[l][tables].reshape(slots, cap)
+                    vs_l = vs_slab[l][tables].reshape(slots, cap)
+                    att = cached_attention(q, k_l, v_l, lengths,
+                                           k_scale=ks_l, v_scale=vs_l)
+                else:
+                    att = cached_attention(q, k_l, v_l, lengths)
                 att = att.transpose(0, 2, 1, 3).reshape(slots, dm)
-                x = x + att @ params["wo"][l].T
+                x = x + _mm(params, att, "wo", l, act)
                 h2 = _ln(x, params["ln2_g"][l], params["ln2_b"][l])
-                h2 = jax.nn.gelu(h2 @ params["w1"][l].T + params["b1"][l])
-                x = x + (h2 @ params["w2"][l].T + params["b2"][l])
-            logits = _ln(x, params["lnf_g"], params["lnf_b"]) \
-                @ params["pred_w"].T + params["pred_b"]
-            return logits, k_slab, v_slab
+                h2 = jax.nn.gelu(_mm(params, h2, "w1", l, act)
+                                 + params["b1"][l])
+                x = x + (_mm(params, h2, "w2", l, act) + params["b2"][l])
+            logits = _mm(params, _ln(x, params["lnf_g"], params["lnf_b"]),
+                         "pred_w", None, act) + params["pred_b"]
+            if ks_slab is None:
+                return logits, k_slab, v_slab
+            return logits, k_slab, v_slab, ks_slab, vs_slab
+
+        if kv_dtype == "int8":
+            def decode(params, k_slab, v_slab, ks_slab, vs_slab, tables,
+                       lengths, tokens):
+                return body(params, k_slab, v_slab, ks_slab, vs_slab,
+                            tables, lengths, tokens)
+        else:
+            def decode(params, k_slab, v_slab, tables, lengths, tokens):
+                return body(params, k_slab, v_slab, None, None, tables,
+                            lengths, tokens)
 
         return decode
 
-    def build_admit(self, slots: int, capacity: int):
+    def build_admit(self, slots: int, capacity: int,
+                    kv_dtype: str = "float32"):
         """Pure fn (k_slab, v_slab, k_new (L,1,Hkv,C,Dh), v_new, slot i32)
         -> updated slabs (donated): slot a freshly prefilled sequence's kv
-        into its allocated row."""
+        into its allocated row. int8 ``kv_dtype`` extends both sides with
+        the (L, 1, C) scale rows prefill returned."""
+        if kv_dtype == "int8":
+            def admit(k_slab, v_slab, ks_slab, vs_slab, k_new, v_new,
+                      ks_new, vs_new, slot):
+                slot = slot.astype(jnp.int32)
+                z = jnp.int32(0)
+                return (jax.lax.dynamic_update_slice(k_slab, k_new,
+                                                     (z, slot, z, z, z)),
+                        jax.lax.dynamic_update_slice(v_slab, v_new,
+                                                     (z, slot, z, z, z)),
+                        jax.lax.dynamic_update_slice(ks_slab, ks_new,
+                                                     (z, slot, z)),
+                        jax.lax.dynamic_update_slice(vs_slab, vs_new,
+                                                     (z, slot, z)))
+
+            return admit
+
         def admit(k_slab, v_slab, k_new, v_new, slot):
             slot = slot.astype(jnp.int32)
             z = jnp.int32(0)

@@ -85,6 +85,10 @@ class PagedKVCacheManager:
         self.var = _engine.new_variable()
         _engine.track_inflight(self.var)
         self.k_slab, self.v_slab = programs.fresh_slabs()
+        # int8 KV: per-position f32 scale slabs (L, NB+1, T), CoW-copied
+        # and scattered by the same programs that move the value blocks
+        scales = programs.fresh_scale_slabs()
+        self.k_scale, self.v_scale = scales if scales else (None, None)
         self._lock = threading.Lock()
         self._lengths = np.zeros(self.slots, np.int32)
         self._owner: List[Optional[object]] = [None] * self.slots
@@ -278,8 +282,10 @@ class PagedKVCacheManager:
         return lengths, tables
 
     # --- slab plumbing (scheduler thread only) ---------------------------
-    def swap_slabs(self, k_slab, v_slab):
+    def swap_slabs(self, k_slab, v_slab, k_scale=None, v_scale=None):
         self.k_slab, self.v_slab = k_slab, v_slab
+        if k_scale is not None:
+            self.k_scale, self.v_scale = k_scale, v_scale
 
     def reset(self):
         """Fresh slabs + empty bookkeeping (server restart / poisoned
@@ -295,6 +301,8 @@ class PagedKVCacheManager:
             self._partial_index.clear()
             self._block_keys.clear()
         self.k_slab, self.v_slab = self.programs.fresh_slabs()
+        scales = self.programs.fresh_scale_slabs()
+        self.k_scale, self.v_scale = scales if scales else (None, None)
 
     def kv_bytes(self) -> int:
         return self.programs.kv_bytes()

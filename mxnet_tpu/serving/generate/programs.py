@@ -30,7 +30,7 @@ import numpy as np
 
 from ... import progcache as _progcache
 from ..batcher import ServingError
-from .model import DecodeModel
+from .model import KV_SLAB_DTYPES, DecodeModel
 
 log = logging.getLogger("mxnet_tpu")
 
@@ -99,7 +99,8 @@ class DecodePrograms:
     """
 
     def __init__(self, model: DecodeModel, slots: int, capacity: int,
-                 prefill_buckets: Sequence[int]):
+                 prefill_buckets: Sequence[int],
+                 kv_dtype: str = "float32"):
         buckets = sorted({int(b) for b in prefill_buckets})
         if not buckets:
             raise ServingError("decode: empty prefill bucket ladder")
@@ -107,38 +108,86 @@ class DecodePrograms:
             raise ServingError(
                 "decode: prefill bucket %d exceeds kv capacity %d"
                 % (buckets[-1], capacity))
+        if kv_dtype not in KV_SLAB_DTYPES:
+            raise ServingError("decode: unknown kv_dtype %r (have %s)"
+                               % (kv_dtype, sorted(KV_SLAB_DTYPES)))
         self.model = model
         self.slots = int(slots)
         self.capacity = int(capacity)
         self.buckets: List[int] = buckets
+        self.kv_dtype = kv_dtype
         self.compiles = 0    # fresh XLA compiles (the CI-gated bound)
         self.disk_hits = 0   # progcache warm loads
         self._params_avals = _avals(model.params)
         self._prefill: Dict[int, _Compiled] = {}
+        elem = KV_SLAB_DTYPES[kv_dtype]
         slab = jax.ShapeDtypeStruct(
-            model.kv_slab_shape(self.slots, self.capacity), jnp.float32)
+            model.kv_slab_shape(self.slots, self.capacity), elem)
         ints = lambda n: jax.ShapeDtypeStruct((n,), jnp.int32)  # noqa: E731
-        self._decode = _Compiled(
-            model.build_decode(self.slots, self.capacity), donate=(1, 2),
-            note="decode_step", avals=(self._params_avals, slab, slab,
-                                       ints(self.slots), ints(self.slots)),
-            counters=self)
         kv_new = jax.ShapeDtypeStruct(
-            model.kv_slab_shape(1, self.capacity), jnp.float32)
-        self._admit = _Compiled(
-            model.build_admit(self.slots, self.capacity), donate=(0, 1),
-            note="decode_admit", avals=(slab, slab, kv_new, kv_new,
-                                        jax.ShapeDtypeStruct((), jnp.int32)),
-            counters=self)
+            model.kv_slab_shape(1, self.capacity), elem)
+        if kv_dtype == "int8":
+            # scale slabs ride as extra donated args right after the value
+            # slabs, so the steady-state step still allocates only logits
+            sslab = jax.ShapeDtypeStruct(
+                model.kv_scale_slab_shape(self.slots, self.capacity),
+                jnp.float32)
+            snew = jax.ShapeDtypeStruct(
+                model.kv_scale_slab_shape(1, self.capacity), jnp.float32)
+            self._decode = _Compiled(
+                model.build_decode(self.slots, self.capacity, kv_dtype),
+                donate=(1, 2, 3, 4), note="decode_step_kv_int8",
+                avals=(self._params_avals, slab, slab, sslab, sslab,
+                       ints(self.slots), ints(self.slots)),
+                counters=self)
+            self._admit = _Compiled(
+                model.build_admit(self.slots, self.capacity, kv_dtype),
+                donate=(0, 1, 2, 3), note="decode_admit_kv_int8",
+                avals=(slab, slab, sslab, sslab, kv_new, kv_new, snew,
+                       snew, jax.ShapeDtypeStruct((), jnp.int32)),
+                counters=self)
+        else:
+            self._decode = _Compiled(
+                model.build_decode(self.slots, self.capacity, kv_dtype),
+                donate=(1, 2),
+                note="decode_step" if kv_dtype == "float32"
+                else "decode_step_kv_%s" % kv_dtype,
+                avals=(self._params_avals, slab, slab,
+                       ints(self.slots), ints(self.slots)),
+                counters=self)
+            self._admit = _Compiled(
+                model.build_admit(self.slots, self.capacity, kv_dtype),
+                donate=(0, 1),
+                note="decode_admit" if kv_dtype == "float32"
+                else "decode_admit_kv_%s" % kv_dtype,
+                avals=(slab, slab, kv_new, kv_new,
+                       jax.ShapeDtypeStruct((), jnp.int32)),
+                counters=self)
 
     # --- shapes -----------------------------------------------------------
     def fresh_slabs(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
         shape = self.model.kv_slab_shape(self.slots, self.capacity)
+        elem = KV_SLAB_DTYPES[self.kv_dtype]
+        return jnp.zeros(shape, elem), jnp.zeros(shape, elem)
+
+    def fresh_scale_slabs(self) -> Optional[Tuple[jnp.ndarray, jnp.ndarray]]:
+        """f32 per-position scale slabs (int8 KV only, else None)."""
+        if self.kv_dtype != "int8":
+            return None
+        shape = self.model.kv_scale_slab_shape(self.slots, self.capacity)
         return jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
 
     def kv_bytes(self) -> int:
+        """Bytes in the K+V slabs INCLUDING int8 scale slabs — the honest
+        number for byte-equivalent pool comparisons."""
         shape = self.model.kv_slab_shape(self.slots, self.capacity)
-        return 2 * int(np.prod(shape)) * 4  # k + v slabs, f32
+        elem = jnp.dtype(KV_SLAB_DTYPES[self.kv_dtype]).itemsize
+        total = 2 * int(np.prod(shape)) * elem
+        if self.kv_dtype == "int8":
+            sshape = self.model.kv_scale_slab_shape(self.slots,
+                                                    self.capacity)
+            total += 2 * int(np.prod(sshape)) * 4
+        return total
 
     def bucket_for(self, prompt_len: int) -> Optional[int]:
         """Smallest ladder bucket holding the prompt, or None (too long)."""
@@ -164,8 +213,11 @@ class DecodePrograms:
         prog = self._prefill.get(bucket)
         if prog is None:
             prog = _Compiled(
-                self.model.build_prefill(bucket, self.capacity), donate=(),
-                note="decode_prefill_%d" % bucket,
+                self.model.build_prefill(bucket, self.capacity,
+                                         self.kv_dtype), donate=(),
+                note="decode_prefill_%d" % bucket
+                if self.kv_dtype == "float32"
+                else "decode_prefill_%d_kv_%s" % (bucket, self.kv_dtype),
                 avals=(self._params_avals,
                        jax.ShapeDtypeStruct((1, bucket), jnp.int32),
                        jax.ShapeDtypeStruct((1,), jnp.int32)),
@@ -178,7 +230,8 @@ class DecodePrograms:
         """Run one prompt through its bucket's prefill program.
 
         Returns (last_logits (V,) ndarray-backed jax array,
-        k_new, v_new (L, 1, Hkv, C, Dh)).
+        k_new, v_new (L, 1, Hkv, C, Dh)); int8 KV appends the (L, 1, C)
+        ks_new, vs_new scale rows.
         """
         n = len(token_ids)
         bucket = self.bucket_for(n)
@@ -188,21 +241,34 @@ class DecodePrograms:
                 % (n, self.buckets[-1]), code="too_large")
         toks = np.zeros((1, bucket), np.int32)
         toks[0, :n] = np.asarray(token_ids, np.int32)
-        last, k_new, v_new = self._prefill_for(bucket)(
+        out = self._prefill_for(bucket)(
             self.model.params, jnp.asarray(toks),
             jnp.asarray([n], jnp.int32))
-        return last[0], k_new, v_new
+        return (out[0][0],) + tuple(out[1:])
 
-    def decode(self, k_slab, v_slab, lengths, tokens):
+    def decode(self, k_slab, v_slab, lengths, tokens, ks_slab=None,
+               vs_slab=None):
         """One step for every slot. ``lengths``/``tokens``: (slots,) i32
         (inactive slots: length 0, token 0 — lanes wasted, never wrong).
-        Donates the slabs; use the returned ones."""
+        Donates the slabs (and int8 scale slabs); use the returned ones.
+        Returns (logits, k, v) or (logits, k, v, ks, vs) for int8 KV."""
+        if self.kv_dtype == "int8":
+            return self._decode(self.model.params, k_slab, v_slab,
+                                ks_slab, vs_slab,
+                                jnp.asarray(lengths, jnp.int32),
+                                jnp.asarray(tokens, jnp.int32))
         return self._decode(self.model.params, k_slab, v_slab,
                             jnp.asarray(lengths, jnp.int32),
                             jnp.asarray(tokens, jnp.int32))
 
-    def admit(self, k_slab, v_slab, k_new, v_new, slot: int):
-        """Slot a prefilled sequence's K/V into the slabs (donates slabs)."""
+    def admit(self, k_slab, v_slab, k_new, v_new, slot: int, ks_slab=None,
+              vs_slab=None, ks_new=None, vs_new=None):
+        """Slot a prefilled sequence's K/V into the slabs (donates slabs).
+        Returns (k, v) or (k, v, ks, vs) for int8 KV."""
+        if self.kv_dtype == "int8":
+            return self._admit(k_slab, v_slab, ks_slab, vs_slab, k_new,
+                               v_new, ks_new, vs_new,
+                               jnp.asarray(slot, jnp.int32))
         return self._admit(k_slab, v_slab, k_new, v_new,
                            jnp.asarray(slot, jnp.int32))
 
@@ -223,7 +289,7 @@ class PagedDecodePrograms(DecodePrograms):
 
     def __init__(self, model: DecodeModel, slots: int, capacity: int,
                  prefill_buckets: Sequence[int], block_tokens: int,
-                 num_blocks: int):
+                 num_blocks: int, kv_dtype: str = "float32"):
         buckets = sorted({int(b) for b in prefill_buckets})
         if not buckets:
             raise ServingError("decode: empty prefill bucket ladder")
@@ -235,10 +301,14 @@ class PagedDecodePrograms(DecodePrograms):
             raise ServingError("decode: block_tokens must be >= 1")
         if num_blocks < 1:
             raise ServingError("decode: need at least one usable KV block")
+        if kv_dtype not in KV_SLAB_DTYPES:
+            raise ServingError("decode: unknown kv_dtype %r (have %s)"
+                               % (kv_dtype, sorted(KV_SLAB_DTYPES)))
         self.model = model
         self.slots = int(slots)
         self.capacity = int(capacity)
         self.buckets: List[int] = buckets
+        self.kv_dtype = kv_dtype
         self.block_tokens = int(block_tokens)
         # MB = per-sequence table width; gathered views are MB*T wide, so
         # every position < capacity is addressable through a table
@@ -250,56 +320,100 @@ class PagedDecodePrograms(DecodePrograms):
         self._prefill: Dict[int, _Compiled] = {}
         slab = jax.ShapeDtypeStruct(
             model.paged_slab_shape(self.num_blocks + 1, self.block_tokens),
-            jnp.float32)
+            KV_SLAB_DTYPES[kv_dtype])
         self._slab_aval = slab
+        self._sslab_aval = None
         ints = lambda n: jax.ShapeDtypeStruct((n,), jnp.int32)  # noqa: E731
         tables = jax.ShapeDtypeStruct((self.slots, self.max_blocks),
                                       jnp.int32)
-        self._decode = _Compiled(
-            model.build_paged_decode(self.slots, self.block_tokens,
-                                     self.max_blocks),
-            donate=(1, 2), note="paged_decode_step",
-            avals=(self._params_avals, slab, slab, tables,
-                   ints(self.slots), ints(self.slots)),
-            counters=self)
+        if kv_dtype == "int8":
+            self._sslab_aval = jax.ShapeDtypeStruct(
+                model.paged_scale_slab_shape(self.num_blocks + 1,
+                                             self.block_tokens),
+                jnp.float32)
+            self._decode = _Compiled(
+                model.build_paged_decode(self.slots, self.block_tokens,
+                                         self.max_blocks, kv_dtype),
+                donate=(1, 2, 3, 4), note="paged_decode_step_kv_int8",
+                avals=(self._params_avals, slab, slab, self._sslab_aval,
+                       self._sslab_aval, tables, ints(self.slots),
+                       ints(self.slots)),
+                counters=self)
+        else:
+            self._decode = _Compiled(
+                model.build_paged_decode(self.slots, self.block_tokens,
+                                         self.max_blocks, kv_dtype),
+                donate=(1, 2),
+                note="paged_decode_step" if kv_dtype == "float32"
+                else "paged_decode_step_kv_%s" % kv_dtype,
+                avals=(self._params_avals, slab, slab, tables,
+                       ints(self.slots), ints(self.slots)),
+                counters=self)
         self._admit = None      # folded into the paged-prefill programs
 
     # --- shapes -----------------------------------------------------------
     def fresh_slabs(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
         shape = self.model.paged_slab_shape(self.num_blocks + 1,
                                             self.block_tokens)
+        elem = KV_SLAB_DTYPES[self.kv_dtype]
+        return jnp.zeros(shape, elem), jnp.zeros(shape, elem)
+
+    def fresh_scale_slabs(self) -> Optional[Tuple[jnp.ndarray, jnp.ndarray]]:
+        if self.kv_dtype != "int8":
+            return None
+        shape = self.model.paged_scale_slab_shape(self.num_blocks + 1,
+                                                  self.block_tokens)
         return jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
 
     def kv_bytes(self) -> int:
         shape = self.model.paged_slab_shape(self.num_blocks + 1,
                                             self.block_tokens)
-        return 2 * int(np.prod(shape)) * 4
+        elem = jnp.dtype(KV_SLAB_DTYPES[self.kv_dtype]).itemsize
+        total = 2 * int(np.prod(shape)) * elem
+        if self.kv_dtype == "int8":
+            sshape = self.model.paged_scale_slab_shape(self.num_blocks + 1,
+                                                       self.block_tokens)
+            total += 2 * int(np.prod(sshape)) * 4
+        return total
 
     def _prefill_for(self, bucket: int) -> _Compiled:
         prog = self._prefill.get(bucket)
         if prog is None:
             scalar = jax.ShapeDtypeStruct((), jnp.int32)
+            common = (jax.ShapeDtypeStruct((self.max_blocks,), jnp.int32),
+                      scalar,
+                      jax.ShapeDtypeStruct((1, bucket), jnp.int32),
+                      jax.ShapeDtypeStruct((1,), jnp.int32),
+                      scalar, scalar)
+            if self.kv_dtype == "int8":
+                avals = (self._params_avals, self._slab_aval,
+                         self._slab_aval, self._sslab_aval,
+                         self._sslab_aval) + common
+                donate = (1, 2, 3, 4)
+                note = "paged_prefill_%d_kv_int8" % bucket
+            else:
+                avals = (self._params_avals, self._slab_aval,
+                         self._slab_aval) + common
+                donate = (1, 2)
+                note = "paged_prefill_%d" % bucket \
+                    if self.kv_dtype == "float32" \
+                    else "paged_prefill_%d_kv_%s" % (bucket, self.kv_dtype)
             prog = _Compiled(
                 self.model.build_paged_prefill(bucket, self.block_tokens,
-                                               self.max_blocks),
-                donate=(1, 2), note="paged_prefill_%d" % bucket,
-                avals=(self._params_avals, self._slab_aval,
-                       self._slab_aval,
-                       jax.ShapeDtypeStruct((self.max_blocks,), jnp.int32),
-                       scalar,
-                       jax.ShapeDtypeStruct((1, bucket), jnp.int32),
-                       jax.ShapeDtypeStruct((1,), jnp.int32),
-                       scalar, scalar),
-                counters=self)
+                                               self.max_blocks,
+                                               self.kv_dtype),
+                donate=donate, note=note, avals=avals, counters=self)
             self._prefill[bucket] = prog
         return prog
 
     # --- execution --------------------------------------------------------
     def paged_prefill(self, k_slab, v_slab, table, ctx_len: int,
-                      suffix: Sequence[int], fork_src: int, fork_dst: int):
+                      suffix: Sequence[int], fork_src: int, fork_dst: int,
+                      ks_slab=None, vs_slab=None):
         """Prefill ``suffix`` against the ``ctx_len``-token cached prefix
         reachable through ``table``, scattering the suffix k/v into its
-        blocks (slabs donated). Returns (last_logits (V,), k, v)."""
+        blocks (slabs donated). Returns (last_logits (V,), k, v) — int8
+        KV appends the updated ks, vs scale slabs."""
         n = len(suffix)
         bucket = self.bucket_for(n)
         if bucket is None:
@@ -308,14 +422,19 @@ class PagedDecodePrograms(DecodePrograms):
                 % (n, self.buckets[-1]), code="too_large")
         toks = np.zeros((1, bucket), np.int32)
         toks[0, :n] = np.asarray(suffix, np.int32)
-        last, k, v = self._prefill_for(bucket)(
-            self.model.params, k_slab, v_slab,
-            jnp.asarray(table, jnp.int32),
-            jnp.asarray(ctx_len, jnp.int32), jnp.asarray(toks),
-            jnp.asarray([n], jnp.int32),
-            jnp.asarray(fork_src, jnp.int32),
-            jnp.asarray(fork_dst, jnp.int32))
-        return last[0], k, v
+        common = (jnp.asarray(table, jnp.int32),
+                  jnp.asarray(ctx_len, jnp.int32), jnp.asarray(toks),
+                  jnp.asarray([n], jnp.int32),
+                  jnp.asarray(fork_src, jnp.int32),
+                  jnp.asarray(fork_dst, jnp.int32))
+        if self.kv_dtype == "int8":
+            out = self._prefill_for(bucket)(
+                self.model.params, k_slab, v_slab, ks_slab, vs_slab,
+                *common)
+        else:
+            out = self._prefill_for(bucket)(
+                self.model.params, k_slab, v_slab, *common)
+        return (out[0][0],) + tuple(out[1:])
 
     def prefill(self, token_ids: Sequence[int]):
         raise ServingError("paged decode has no standalone prefill — "
@@ -325,9 +444,17 @@ class PagedDecodePrograms(DecodePrograms):
         raise ServingError("paged decode has no standalone admit — "
                            "the paged-prefill program scatters in place")
 
-    def decode(self, k_slab, v_slab, tables, lengths, tokens):
+    def decode(self, k_slab, v_slab, tables, lengths, tokens,
+               ks_slab=None, vs_slab=None):
         """One step for every slot, indexed through the block tables.
-        Donates the slabs; use the returned ones."""
+        Donates the slabs; use the returned ones. int8 KV takes and
+        returns the scale slabs after the value slabs."""
+        if self.kv_dtype == "int8":
+            return self._decode(self.model.params, k_slab, v_slab,
+                                ks_slab, vs_slab,
+                                jnp.asarray(tables, jnp.int32),
+                                jnp.asarray(lengths, jnp.int32),
+                                jnp.asarray(tokens, jnp.int32))
         return self._decode(self.model.params, k_slab, v_slab,
                             jnp.asarray(tables, jnp.int32),
                             jnp.asarray(lengths, jnp.int32),

@@ -114,6 +114,15 @@ class GenerateConfig:
         default_factory=lambda: _env_int("MXNET_DECODE_BLOCKS", 0))
     prefix_share: bool = dataclasses.field(
         default_factory=lambda: _env_flag("MXNET_DECODE_PREFIX_SHARE", "1"))
+    # low-precision serving (PR 14): KV slab dtype (f32|bf16|int8 —
+    # normalized by mxnet_tpu.quant at scheduler construction) and weight
+    # PTQ opt-in ("" = off; "int8"/"fp8" quantizes the DecodeModel)
+    kv_dtype: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "MXNET_DECODE_KV_DTYPE", "f32"))
+    quant_weights: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "MXNET_QUANT_WEIGHT_DTYPE", ""))
 
 
 class _Active:
@@ -133,18 +142,28 @@ class DecodeScheduler:
 
     def __init__(self, model: DecodeModel, config: GenerateConfig,
                  replicas: int = 1):
+        from ... import quant as _quant   # lazy — avoids an import cycle
+
         self.config = config
+        kv_dtype = _quant.normalize_kv_dtype(config.kv_dtype)
+        self.kv_dtype = kv_dtype
+        if config.quant_weights and "wq_scale" not in model.params:
+            model = _quant.quantize_decode_model(
+                model, _quant.QuantConfig(
+                    weight_dtype=config.quant_weights))
         self.model = model
         if config.paged:
             blocks = config.num_blocks or config.slots * (
                 -(-config.max_context // config.block_tokens))
             self.programs: DecodePrograms = PagedDecodePrograms(
                 model, config.slots, config.max_context,
-                config.prefill_buckets, config.block_tokens, blocks)
+                config.prefill_buckets, config.block_tokens, blocks,
+                kv_dtype=kv_dtype)
         else:
             self.programs = DecodePrograms(model, config.slots,
                                            config.max_context,
-                                           config.prefill_buckets)
+                                           config.prefill_buckets,
+                                           kv_dtype=kv_dtype)
         self.replicas = int(replicas)
         self.caches: List[KVCacheManager] = []
         self._cond = threading.Condition()       # rank 50
@@ -164,11 +183,16 @@ class DecodeScheduler:
             help="decode slots occupied, % (mean over replicas)")
         self._m_kv = reg.gauge(
             "kv_bytes", help="bytes held in decode KV slabs")
+        # split-by-dtype twin of kv_bytes (the unlabeled gauge keeps its
+        # historical meaning; capacity planning reads the labeled series)
+        self._m_kv_dtype = reg.gauge(
+            "kv_bytes", labels={"dtype": kv_dtype},
+            help="bytes held in decode KV slabs")
         self._m_blocks_free = reg.gauge(
-            "kv_blocks_free",
+            "kv_blocks_free", labels={"decode_kv_dtype": kv_dtype},
             help="free KV blocks in the paged pool (sum over replicas)")
         self._m_blocks_total = reg.gauge(
-            "kv_blocks_total",
+            "kv_blocks_total", labels={"decode_kv_dtype": kv_dtype},
             help="usable KV blocks in the paged pool (sum over replicas)")
         self._m_prefix_hits = reg.counter(
             "decode_prefix_hits_total",
@@ -200,7 +224,9 @@ class DecodeScheduler:
         self._captures = [
             _engine.CapturedSequence(name="decode_step_r%d" % i)
             if use_capture else None for i in range(self.replicas)]
-        self._m_kv.set(sum(c.kv_bytes() for c in self.caches))
+        kv_total = sum(c.kv_bytes() for c in self.caches)
+        self._m_kv.set(kv_total)
+        self._m_kv_dtype.set(kv_total)
         self._thread = threading.Thread(target=self._loop,
                                         name="decode-scheduler", daemon=True)
         self._thread.start()
@@ -388,12 +414,13 @@ class DecodeScheduler:
             if self.config.paged:
                 def op(cache=cache, plan=plan, holder=holder):
                     def run():
-                        last, k, v = self.programs.paged_prefill(
+                        out = self.programs.paged_prefill(
                             cache.k_slab, cache.v_slab, plan.table,
                             plan.ctx_len, plan.suffix,
-                            plan.fork_src, plan.fork_dst)
-                        cache.swap_slabs(k, v)
-                        holder["token"] = int(np.asarray(last).argmax())
+                            plan.fork_src, plan.fork_dst,
+                            ks_slab=cache.k_scale, vs_slab=cache.v_scale)
+                        cache.swap_slabs(*out[1:])
+                        holder["token"] = int(np.asarray(out[0]).argmax())
                     try:
                         with _telemetry.span(
                                 "decode.prefill", domain="serving",
@@ -415,12 +442,21 @@ class DecodeScheduler:
                         with _telemetry.span("decode.prefill",
                                              domain="serving",
                                              tokens=len(plan.suffix)):
-                            last, k_new, v_new = \
-                                self.programs.prefill(plan.suffix)
-                            k, v = self.programs.admit(
-                                cache.k_slab, cache.v_slab, k_new, v_new,
-                                plan.slot)
-                            cache.swap_slabs(k, v)
+                            pre = self.programs.prefill(plan.suffix)
+                            if len(pre) == 5:   # int8 KV: + scale rows
+                                last, k_new, v_new, ks_new, vs_new = pre
+                                out = self.programs.admit(
+                                    cache.k_slab, cache.v_slab, k_new,
+                                    v_new, plan.slot,
+                                    ks_slab=cache.k_scale,
+                                    vs_slab=cache.v_scale,
+                                    ks_new=ks_new, vs_new=vs_new)
+                            else:
+                                last, k_new, v_new = pre
+                                out = self.programs.admit(
+                                    cache.k_slab, cache.v_slab, k_new,
+                                    v_new, plan.slot)
+                            cache.swap_slabs(*out)
                             holder["token"] = int(np.asarray(last).argmax())
                     except Exception as e:      # noqa: BLE001
                         holder["error"] = e
@@ -486,14 +522,17 @@ class DecodeScheduler:
                     with _telemetry.span("decode.step", domain="serving",
                                          rows=int((lengths > 0).sum())):
                         if tables is not None:
-                            logits, k, v = self.programs.decode(
+                            out = self.programs.decode(
                                 cache.k_slab, cache.v_slab, tables,
-                                lengths, tokens)
+                                lengths, tokens, ks_slab=cache.k_scale,
+                                vs_slab=cache.v_scale)
                         else:
-                            logits, k, v = self.programs.decode(
-                                cache.k_slab, cache.v_slab, lengths, tokens)
-                        cache.swap_slabs(k, v)
-                        holder["logits"] = np.asarray(logits)
+                            out = self.programs.decode(
+                                cache.k_slab, cache.v_slab, lengths,
+                                tokens, ks_slab=cache.k_scale,
+                                vs_slab=cache.v_scale)
+                        cache.swap_slabs(*out[1:])
+                        holder["logits"] = np.asarray(out[0])
                 except Exception as e:          # noqa: BLE001
                     holder["error"] = e
 
@@ -532,7 +571,9 @@ class DecodeScheduler:
             active = len(self._active)
         st = {"compiles": self.programs.compiles,
               "disk_hits": self.programs.disk_hits,
-              "steps": self.steps, "queued": queued, "active": active}
+              "steps": self.steps, "queued": queued, "active": active,
+              "kv_dtype": self.kv_dtype,
+              "quant_weights": self.config.quant_weights or "off"}
         if self.config.paged and self.caches:
             st["blocks_total"] = sum(c.blocks_total() for c in self.caches)
             st["blocks_free"] = sum(c.blocks_free() for c in self.caches)

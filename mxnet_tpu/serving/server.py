@@ -101,6 +101,13 @@ class ServingConfig:
     #: ONE fused XLA program, bailing back to replay when acquire()
     #: resolves a different bucket/program than the staged one
     fuse: bool = field(default_factory=lambda: engine.fuse_enabled())
+    #: post-training weight quantization for the replicas: "" (off,
+    #: default — f32 path bitwise untouched) | int8 | fp8_e4m3. Each
+    #: replica binds a mxnet_tpu.quant.QuantizedPredictor; the whole
+    #: bucket ladder shares ONE quantization pass (docs/deployment.md
+    #: "Quantized serving").
+    quant_weights: str = field(default_factory=lambda: os.environ.get(
+        "MXNET_QUANT_WEIGHT_DTYPE", ""))
 
 
 class _Replica:
@@ -164,6 +171,8 @@ class InferenceServer:
                 symbol_json, params,
                 {n: (smallest,) + s for n, s in self._example_shapes.items()},
                 dtype=dtype, device=dev)
+            if self.config.quant_weights:
+                base = base.quantize(self.config.quant_weights)
             cache = BucketCache(base, self.config.buckets, device=dev)
             var = engine.new_variable()
             # opt this var into the engine's per-var in-flight accounting:

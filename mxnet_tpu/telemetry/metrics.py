@@ -57,18 +57,37 @@ class Counter:
         return [(self.name, self._value)]
 
 
+def _render_labels(labels) -> str:
+    """``{k="v",...}`` suffix, keys sorted (stable registry identity)."""
+    if not labels:
+        return ""
+    return "{%s}" % ",".join(
+        '%s="%s"' % (_sanitize(str(k)), str(v).replace('"', "'"))
+        for k, v in sorted(labels.items()))
+
+
 class Gauge:
     """Point-in-time value: either ``set()`` explicitly or computed by a
-    callback ``fn`` at read time (e.g. the engine's pending-op depth)."""
+    callback ``fn`` at read time (e.g. the engine's pending-op depth).
 
-    __slots__ = ("name", "help", "_value", "_fn")
+    Optional ``labels`` make this one SERIES of a labeled family — the
+    registry keys labeled gauges by ``name{k="v"}``, so
+    ``gauge("kv_bytes", labels={"dtype": "int8"})`` and the unlabeled
+    ``gauge("kv_bytes")`` are distinct metrics (the unlabeled spelling is
+    bitwise unchanged by this feature)."""
+
+    __slots__ = ("name", "help", "_value", "_fn", "labels")
 
     def __init__(self, name: str, fn: Optional[Callable[[], float]] = None,
-                 help: str = ""):
+                 help: str = "", labels: Optional[Dict[str, str]] = None):
         self.name = name
         self.help = help
         self._value = 0.0
         self._fn = fn
+        self.labels = dict(labels) if labels else None
+
+    def sample_name(self) -> str:
+        return self.name + _render_labels(self.labels)
 
     def set(self, v):
         if not _master_enabled():
@@ -85,7 +104,7 @@ class Gauge:
         return self._value
 
     def get_name_value(self):
-        return [(self.name, self.value)]
+        return [(self.sample_name(), self.value)]
 
 
 class Histogram:
@@ -146,15 +165,16 @@ class Registry:
         self._groups: List[Tuple[str, int, "weakref.ref"]] = []
         self._next_sid = 0
 
-    def _get_or_create(self, name, cls, *args, **kwargs):
+    def _get_or_create(self, name, cls, *args, key=None, **kwargs):
+        key = key or name
         with self._lock:
-            m = self._metrics.get(name)
+            m = self._metrics.get(key)
         if m is None:
             # construct outside the lock (lockorder: no callable runs under
             # _lock); a racing creator loses benignly to setdefault
             fresh = cls(name, *args, **kwargs)
             with self._lock:
-                m = self._metrics.setdefault(name, fresh)
+                m = self._metrics.setdefault(key, fresh)
         if not isinstance(m, cls):
             raise TypeError("metric %r already registered as %s"
                             % (name, type(m).__name__))
@@ -164,8 +184,13 @@ class Registry:
         return self._get_or_create(name, Counter, help)
 
     def gauge(self, name: str, fn: Optional[Callable[[], float]] = None,
-              help: str = "") -> Gauge:
-        return self._get_or_create(name, Gauge, fn, help)
+              help: str = "",
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        """Labeled gauges are keyed by ``name{k="v"}`` — each label set is
+        its own series; omitting ``labels`` keeps the historical
+        single-series behavior."""
+        return self._get_or_create(name, Gauge, fn, help, labels,
+                                   key=name + _render_labels(labels))
 
     def histogram(self, name: str, buckets: Sequence[float] = (),
                   help: str = "") -> Histogram:
@@ -223,16 +248,20 @@ class Registry:
         and group ``get_name_value()`` run outside the registry lock."""
         metrics, groups = self._snapshot()
         out: List[str] = []
+        typed = set()  # emit HELP/TYPE once per family (labeled series)
         for m in metrics:
             name = _sanitize(m.name)
-            if m.help:
+            if m.help and name not in typed:
                 out.append("# HELP %s %s" % (name, m.help.replace("\n", " ")))
             if isinstance(m, Counter):
                 out.append("# TYPE %s counter" % name)
                 out.append("%s %s" % (name, _fmt(m.value)))
             elif isinstance(m, Gauge):
-                out.append("# TYPE %s gauge" % name)
-                out.append("%s %s" % (name, _fmt(m.value)))
+                if name not in typed:
+                    out.append("# TYPE %s gauge" % name)
+                    typed.add(name)
+                out.append("%s%s %s" % (name, _render_labels(m.labels),
+                                        _fmt(m.value)))
             elif isinstance(m, Histogram):
                 out.append("# TYPE %s histogram" % name)
                 counts, s, n = m.snapshot()

@@ -473,6 +473,20 @@ _fwd("Proposal:", lambda: (
      "bbox_pred": _u((1, 4, 3, 3), -0.1, 0.1),
      "im_info": np.array([[12, 12, 1.0]], np.float32)}))
 
+# inference-only PTQ op (weight/scale declared no-grad): forward coverage
+# with dequant-on-load act_dtype — integer-valued int8 weights are exact
+# in every compute dtype, so the bf16 consistency sweep applies too. The
+# int8-activation path (dynamic quantization buckets, legitimately
+# dtype-sensitive at bucket boundaries) is covered in tests/test_quant.py.
+_fwd("QuantizedFullyConnected:", lambda: (
+    sym.QuantizedFullyConnected(
+        V("data"), V("weight"), V("scale"), V("bias"), num_hidden=4,
+        act_dtype="float32"),
+    {"data": _u((2, 3)),
+     "weight": np.round(_u((4, 3), -127, 127)).astype(np.float32),
+     "scale": _u((4,), 0.005, 0.02),
+     "bias": _u((4,), -0.1, 0.1)}))
+
 
 @pytest.mark.parametrize("cid,build", FWD_CASES, ids=[c[0] for c in FWD_CASES])
 def test_forward_executes(cid, build):
