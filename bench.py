@@ -1628,6 +1628,157 @@ def run_quant_kv_config():
     }
 
 
+def run_zero_config():
+    """ZeRO stage A/B on the transformer LM over a dp mesh
+    (BENCH_MODEL=zero, ISSUE 15): the SAME model, init, and batch
+    trained through Executor.make_train_step built once per
+    MXNET_SHARDED_UPDATE stage 1 / 2 / 3 — stage is read at build time,
+    so each arm is its own donated XLA program over the shared mesh.
+
+    Methodology mirrors run_quant_weight_config: all arms built and
+    warmed first, then each repeat times the arms back-to-back
+    (interleaved, so drift hits every arm equally) and contributes ONE
+    paired ratio per comparison; the reported ratios are the MEDIAN of
+    those per-repeat pairs. Alongside step time, each arm records its
+    bytes/chip: param/grad bounds from the stage's layout
+    (collectives.stage_train_bytes) and optimizer-state bytes measured
+    off the live sharded buffers (collectives.per_device_bytes).
+
+    value = ZeRO-3 / ZeRO-1 step-time ratio. ISSUE 15 gate: <= 1.15x,
+    so vs_baseline = 1.15 / value (>= 1.0 passes)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import collectives as coll
+
+    dp = int(os.environ.get("BENCH_ZERO_DP", "0")) or min(
+        4, jax.device_count())
+    if dp < 2:
+        raise RuntimeError(
+            "BENCH_MODEL=zero needs a >1-device data axis (have %d; on "
+            "CPU set XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+            % jax.device_count())
+    mesh = Mesh(np.array(jax.devices()[:dp]), ("data",))
+
+    batch = int(os.environ.get("BENCH_ZERO_BATCH", "16"))
+    seq = int(os.environ.get("BENCH_ZERO_SEQ", "512"))
+    model_dim = int(os.environ.get("BENCH_ZERO_DIM", "1024"))
+    num_layers = int(os.environ.get("BENCH_ZERO_LAYERS", "4"))
+    vocab = int(os.environ.get("BENCH_ZERO_VOCAB", "8000"))
+    iters = max(1, min(ITERS, 2048 // batch))
+    repeats = REPEATS
+    heads = model_dim // 128 if model_dim % 128 == 0 else max(
+        1, model_dim // 64)
+    cdtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    lr, momentum, wd = 0.05, 0.9, 1e-4
+
+    def sgd_all(params, grads, moms):
+        new_p, new_m = {}, {}
+        for n in params:
+            g = grads[n] + wd * params[n]
+            m = momentum * moms[n] - lr * g
+            new_p[n] = params[n] + m
+            new_m[n] = m
+        return new_p, new_m
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randint(0, vocab, (batch, seq)).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, vocab, (batch, seq)).astype(np.float32))
+    feed = {"data": x, "softmax_label": y}
+
+    def build(stage):
+        """One arm: executor + fused train step built under the stage's
+        env (sharded_stage reads MXNET_SHARDED_UPDATE at build time),
+        identically initialized via the seeded global RNG."""
+        prev = os.environ.get("MXNET_SHARDED_UPDATE")
+        os.environ["MXNET_SHARDED_UPDATE"] = str(stage)
+        try:
+            sym = models.get_symbol(
+                "transformer-lm", num_classes=vocab, num_layers=num_layers,
+                num_heads=heads, model_dim=model_dim, ffn_dim=4 * model_dim,
+                num_kv_heads=min(4, heads), scalar_loss=True)
+            arg_names = sym.list_arguments()
+            grad_req = {n: ("null" if n in ("data", "softmax_label")
+                            else "write") for n in arg_names}
+            exe = sym.simple_bind(
+                mx.Context("tpu", 0) if jax.default_backend() != "cpu"
+                else mx.cpu(), grad_req=grad_req, compute_dtype=cdtype,
+                data=(batch, seq), softmax_label=(batch, seq))
+            mx.random.seed(0)
+            init = mx.initializer.Xavier(factor_type="in", magnitude=2.0)
+            for name, arr in exe.arg_dict.items():
+                if name not in ("data", "softmax_label"):
+                    init(mx.initializer.InitDesc(name), arr)
+            step = exe.make_train_step(sgd_all, mesh=mesh)
+            params = {n: jnp.array(exe.arg_dict[n]._data, copy=True)
+                      for n in arg_names
+                      if n not in ("data", "softmax_label")}
+            moms = {n: jnp.zeros_like(v) for n, v in params.items()}
+            pb, gb = coll.stage_train_bytes(params, stage, dp)
+            return {"stage": stage, "step": step, "params": params,
+                    "moms": moms, "param_bytes": pb, "grad_bytes": gb}
+        finally:
+            if prev is None:
+                os.environ.pop("MXNET_SHARDED_UPDATE", None)
+            else:
+                os.environ["MXNET_SHARDED_UPDATE"] = prev
+
+    arms = [build(stage) for stage in (1, 2, 3)]
+
+    def run_block(arm, n):
+        outs = None
+        for _ in range(n):
+            outs, arm["params"], arm["moms"] = arm["step"](
+                arm["params"], arm["moms"], feed)
+        np.asarray(jnp.reshape(outs[0], (-1,))[0])  # readback sync
+
+    for arm in arms:
+        run_block(arm, WARMUP)
+        # measured AFTER the first step commits state to the stage's
+        # layout — live per-chip bytes, not the analytic bound
+        arm["opt_bytes"] = coll.per_device_bytes(arm["moms"])
+
+    times = {arm["stage"]: [] for arm in arms}
+    for _ in range(repeats):
+        for arm in arms:  # back-to-back inside the repeat
+            t0 = time.perf_counter()
+            run_block(arm, iters)
+            times[arm["stage"]].append((time.perf_counter() - t0) / iters)
+    z2_over_z1 = statistics.median(
+        b / a for a, b in zip(times[1], times[2]))
+    z3_over_z1 = statistics.median(
+        b / a for a, b in zip(times[1], times[3]))
+
+    rec = {
+        "metric": "zero_sharded_train_dp%d" % dp,
+        "value": round(z3_over_z1, 4),
+        "unit": "zero3_over_zero1_step_time_ratio",
+        # the <= 1.15x gate: >= 1.0 passes
+        "vs_baseline": round(1.15 / z3_over_z1, 3),
+        "z2_over_z1_step_time": round(z2_over_z1, 4),
+        "z3_over_z1_step_time": round(z3_over_z1, 4),
+        "dp": dp,
+        "model": "decoder LM L=%d d_model=%d heads=%d vocab=%d bs%d seq%d"
+                 % (num_layers, model_dim, heads, vocab, batch, seq),
+        "compute_dtype": cdtype,
+        "timing": "interleaved arms, median of %d paired repeats x %d "
+                  "steps, readback sync" % (repeats, iters),
+        "gate": "ZeRO-3 step time <= 1.15x ZeRO-1 (ISSUE 15)",
+    }
+    for arm in arms:
+        rec["zero%d" % arm["stage"]] = {
+            "step_time_ms": round(
+                statistics.median(times[arm["stage"]]) * 1e3, 3),
+            "param_bytes_per_chip": arm["param_bytes"],
+            "grad_bytes_per_chip": arm["grad_bytes"],
+            "opt_bytes_per_chip": arm["opt_bytes"],
+        }
+    return rec
+
+
 def main():
     try:
         _main()
@@ -1657,6 +1808,9 @@ def _main():
     if which == "quant":
         _emit(run_quant_weight_config())
         _emit(run_quant_kv_config())
+        return
+    if which == "zero":
+        _emit(run_zero_config())
         return
     if os.environ.get("BENCH_LM_SWEEP"):
         # transformer (bs, seq) MFU table (docs/perf.md); one JSON line

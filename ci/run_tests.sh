@@ -131,5 +131,16 @@ import __graft_entry__ as g; g.dryrun_fault_tolerance()
 from mxnet_tpu import engine
 assert engine.sanitizer_reports() == [], engine.sanitizer_reports()
 print('sanitizer: 0 reports (fault dryrun)')"
+# Composed dp×pp gate (ISSUE 15): ZeRO-sharded data parallelism (data=4)
+# composed with 1f1b pipeline stages (pipe=2) in one shard_map program,
+# run under TrainingSupervisor with the same kill-a-rank plan — replay
+# must be BITWISE identical to an uninterrupted run, and the final
+# checkpoint must reshard dp=4 -> 2 -> 4 bitwise.
+JAX_PLATFORMS=cpu MXNET_FAULT_PLAN="kill_rank rank=1 step=5" \
+    MXNET_ENGINE_SANITIZER=1 python -c "
+import __graft_entry__ as g; g.dryrun_composed_fault()
+from mxnet_tpu import engine
+assert engine.sanitizer_reports() == [], engine.sanitizer_reports()
+print('sanitizer: 0 reports (composed dp x pp fault dryrun)')"
 
 echo "ALL CI STAGES PASSED"

@@ -241,16 +241,22 @@ class Executor:
         MXNET_STEP_AUTO_LAYOUT=0 disables.
 
         ``mesh``: a jax Mesh with a data-parallel axis ``shard_axis``.
-        When its size is > 1 (and MXNET_SHARDED_UPDATE != 0) the update
-        phase runs ZeRO-1 sharded (Xu et al., PAPERS.md): the f32 master
-        weights and optimizer state live 1/N-sharded across the data
-        axis, gradients are reduce-scattered onto the shards, each
-        replica updates only its shard, and the new weights are
-        all-gathered for the next forward — all expressed as sharding
-        constraints inside the ONE donated program, so XLA's SPMD
-        partitioner places (and overlaps) the collectives. The first
-        call commits params/states to the sharded layout; returned
-        values stay sharded, so thread them back in as usual.
+        When its size is > 1, MXNET_SHARDED_UPDATE picks the ZeRO stage
+        (Xu et al., PAPERS.md; docs/parallelism.md "ZeRO-2/3"). Stage 1
+        (default): f32 master weights and optimizer state live
+        1/N-sharded across the data axis, gradients are reduce-scattered
+        onto the shards, each replica updates only its shard, and the
+        new weights are all-gathered for the next forward. Stage 2
+        additionally scatters each gradient bucket at its producer site
+        as backward emits it (zero2_grad_scatter — full gradients never
+        materialize). Stage 3 additionally keeps the parameters sharded
+        THROUGH the step: leaves all-gather on demand in forward and
+        re-gather in backward (zero3_gather + zero3_remat), so
+        param+grad+opt bytes/chip are all ~1/N. Every stage is expressed
+        as sharding constraints inside the ONE donated program, so XLA's
+        SPMD partitioner places (and overlaps) the collectives; 0 opts
+        out. The first call commits params/states to the sharded layout;
+        returned values stay sharded, so thread them back in as usual.
         """
         eval_fn = self._eval_fn
         grad_names = list(self._grad_names_list())
@@ -258,19 +264,31 @@ class Executor:
         cd = self._compute_dtype
         chain = max(1, int(chain))
         from .parallel import collectives as _coll
-        sharded = _coll.zero1_enabled(mesh, shard_axis)
+        stage = _coll.sharded_stage(mesh, shard_axis)
+        sharded = stage >= 1
 
         def one_step(params, states, aux_values, rng, data_values, *extra):
-            # ZeRO-1: params arrive 1/N-sharded; gather them replicated
-            # for forward/backward. vjp's transpose of the gather is a
-            # reduction back to the shard layout, which — fused with the
-            # data-parallel gradient psum — is exactly reduce_scatter.
-            full = (_coll.replicate_constrain(params, mesh)
-                    if sharded else params)
+            # Stage 1/2: params arrive 1/N-sharded; gather the whole tree
+            # replicated up front for forward/backward (vjp's transpose of
+            # the gather, fused with the data-parallel psum, is exactly
+            # reduce_scatter). Stage 3 differentiates the SHARDED tree
+            # directly: each leaf is gathered on demand inside `f` and
+            # re-gathered in backward (zero3_remat drops the gathered
+            # copies from the residuals), so full weights are transient.
+            arg = (_coll.replicate_constrain(params, mesh)
+                   if sharded and stage < 3 else params)
 
             def f(p):
+                full = (_coll.zero3_gather(p, mesh, shard_axis)
+                        if stage >= 3 else p)
+                if stage >= 2:
+                    # ZeRO-2: backward emits reduce-scattered gradient
+                    # shards bucket-by-bucket as it runs (overlapping the
+                    # remaining backward compute) instead of materializing
+                    # the full gradient tree first
+                    full = _coll.zero2_grad_scatter(full, mesh, shard_axis)
                 av = dict(data_values)
-                av.update(p)
+                av.update(full)
                 auxv = aux_values
                 if cd is not None:
                     av = _cast_floats(av, cd)
@@ -281,7 +299,8 @@ class Executor:
                     aux_up = _cast_floats(aux_up, jnp.float32, src=cd)
                 return outs, aux_up
 
-            (outs, aux_up), vjp = jax.vjp(f, full)
+            fd = _coll.zero3_remat(f) if stage >= 3 else f
+            (outs, aux_up), vjp = jax.vjp(fd, arg)
             (grads,) = vjp(([jnp.ones_like(o) for o in outs],
                             {k: jnp.zeros_like(v) for k, v in aux_up.items()}))
             if sharded:
@@ -331,12 +350,39 @@ class Executor:
                     dv[n] = self.arg_dict[n]._data
             if sharded and not aot.get("placed"):
                 # first bind: materialize master weights + optimizer state
-                # directly in the 1/N ZeRO-1 layout (never
+                # directly in the 1/N ZeRO layout (never
                 # replicated-then-sliced); returned values keep it, so
                 # this runs once
                 params = _coll.zero1_place(params, mesh, shard_axis)
                 states = _coll.zero1_place(states, mesh, shard_axis)
                 aot["placed"] = True
+            if not aot.get("gauges"):
+                # per-chip byte gauges, one series per ZeRO stage:
+                # param/grad from the stage's layout contract
+                # (collectives.stage_train_bytes — gradients are
+                # in-program transients XLA never exposes), opt measured
+                # from the live optimizer-state tree
+                n_sh = (int(dict(mesh.shape).get(shard_axis, 1))
+                        if mesh is not None else 1)
+                pb, gb = _coll.stage_train_bytes(
+                    params, stage, max(1, n_sh), shard_axis)
+                lbl = {"stage": str(stage)}
+                _telemetry.registry.gauge(
+                    "train_param_bytes", labels=lbl,
+                    help="per-chip parameter bytes held through one train "
+                         "step (layout-implied)").set(pb)
+                _telemetry.registry.gauge(
+                    "train_grad_bytes", labels=lbl,
+                    help="per-chip gradient bytes at the reduction "
+                         "boundary (layout-implied)").set(gb)
+                _telemetry.registry.gauge(
+                    "train_opt_bytes", labels=lbl,
+                    help="per-chip optimizer-state bytes at rest "
+                         "(measured)").set(_coll.per_device_bytes(states))
+                aot["gather_bytes"] = sum(
+                    int(a.size * jnp.dtype(a.dtype).itemsize)
+                    for a in jax.tree_util.tree_leaves(params))
+                aot["gauges"] = True
             if use_auto:
                 if not aot.get("informats"):
                     from jax.experimental.layout import Format, Layout
@@ -452,7 +498,19 @@ class Executor:
             # step (argument prep, dispatch, first-call trace+compile); the
             # device timeline comes from the jax trace merged at dump time
             with _telemetry.span("executor.train_step", domain="executor",
-                                 chain=chain, sharded=bool(sharded)):
+                                 chain=chain, sharded=bool(sharded),
+                                 stage=stage):
+                if stage >= 3:
+                    # marks the dispatch window in which the device runs
+                    # the on-demand weight gathers (one-leaf prefetch under
+                    # XLA's latency-hiding scheduler) — dump_profile()
+                    # shows this span over the device timeline
+                    with _telemetry.span("train.allgather_prefetch",
+                                         domain="executor",
+                                         gather_bytes=aot.get(
+                                             "gather_bytes", 0)):
+                        return _run_impl(params, states, data_values,
+                                         *extra)
                 return _run_impl(params, states, data_values, *extra)
 
         # trace-and-fuse metadata (engine.FuseOp): the pure `step` plus the
@@ -462,7 +520,7 @@ class Executor:
         # fused program would not reproduce, so they are fuse-ineligible.
         run.fuse = {"step": step, "data_names": data_names,
                     "executor": self, "use_auto": use_auto,
-                    "sharded": bool(sharded)}
+                    "sharded": bool(sharded), "stage": stage}
         return run
 
     def _next_rng(self):

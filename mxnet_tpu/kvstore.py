@@ -129,6 +129,20 @@ class KVStore:
                             stored._data = jax.device_put(stored._data, gsh)
                     self._updater(_updater_key(k), merged, stored)
                 else:
+                    prev = self._store.get(k)
+                    if prev is not None:
+                        ssh = prev._data.sharding
+                        gsh = merged._data.sharding
+                        if (ssh != gsh
+                                and ssh.device_set == gsh.device_set
+                                and not ssh.is_fully_replicated):
+                            # no-updater aggregation must not densify a
+                            # deliberately sharded stored value (ZeRO
+                            # weight layout): reshard the merged result TO
+                            # the stored layout before replacing it —
+                            # mirrors the updater branch above
+                            merged = NDArray(jax.device_put(merged._data,
+                                                            ssh))
                     self._store[k] = merged
         _push_total.inc(len(keys))
         _push_bytes.inc(nbytes)

@@ -429,8 +429,21 @@ class Module(BaseModule):
         state leaves (``opt:<name>:<leaf>``), plus an ``opt_meta`` dict
         with the update counts. The flat dict feeds
         ``resilience.checkpoint.save_sharded`` directly; the snapshot is
-        consistent (fused/donated buffers are synced out first)."""
+        consistent (fused/donated buffers are synced out first).
+
+        When the fused ZeRO state is live, the snapshot reads host copies
+        straight off the 1/N device shards (``np.asarray`` assembles the
+        flat value shard-by-shard on the host — the contiguous layout
+        matches ``checkpoint._shard_range``'s divmod plan, so the write
+        stays local). The pre-fix path went through ``get_params``, whose
+        fused→exec sync ``replicate_place``s every master leaf — committing
+        a FULL replicated copy of params + optimizer state to every device
+        just to checkpoint them. The fused snapshot stays authoritative;
+        exec buffers are not touched."""
         assert self.binded and self.params_initialized
+        fs = self._fused_fit if isinstance(self._fused_fit, dict) else None
+        if fs is not None and fs.get("z1") and self.optimizer_initialized:
+            return self._sharded_checkpoint_state(fs)
         arg_params, aux_params = self.get_params()  # syncs fused → exec
         arrays = {}
         for n, a in arg_params.items():
@@ -457,6 +470,36 @@ class Module(BaseModule):
                     str(k): int(v)
                     for k, v in opt_._index_update_count.items()},
             }
+        return arrays, opt_meta
+
+    def _sharded_checkpoint_state(self, fs):
+        """ZeRO local-write snapshot: host arrays from the live fused
+        1/N-sharded params/optimizer state, without replicating anything
+        on device (see :meth:`get_checkpoint_state`)."""
+        cap = fs.get("capture")
+        if cap is not None:  # in-flight replayed steps finish first
+            cap.fence()
+        self._materialize_fused_counts(fs)
+        arrays = {}
+        for n in fs["names"]:
+            arrays["param:%s" % n] = np.asarray(fs["params"][n])
+            leaves = fs["states"][n]
+            if leaves is None:
+                continue
+            if not isinstance(leaves, tuple):
+                leaves = (leaves,)
+            for li, leaf in enumerate(leaves):
+                if leaf is not None:
+                    arrays["opt:%s:%d" % (n, li)] = np.asarray(leaf)
+        for n, a in self._exec_group._exec.aux_dict.items():
+            arrays["aux:%s" % n] = a.asnumpy()
+        opt_ = self._optimizer
+        opt_meta = {
+            "num_update": int(opt_.num_update),
+            "index_update_count": {
+                str(k): int(v)
+                for k, v in opt_._index_update_count.items()},
+        }
         return arrays, opt_meta
 
     def restore_checkpoint_state(self, arrays, opt_meta=None):
@@ -773,7 +816,8 @@ class Module(BaseModule):
         # step reduce-scatters grads / all-gathers updated weights inside
         # the one donated program (Executor.make_train_step mesh path)
         mesh = getattr(self._exec_group, "mesh", None)
-        z1 = _collectives.zero1_enabled(mesh)
+        stage = _collectives.sharded_stage(mesh)
+        z1 = stage >= 1
         step = exec_.make_train_step(update_fn, mesh=mesh)
         # device-side copies: the step donates these, and donation must not
         # delete buffers aliased by exec arg_dict / user-held NDArrays
@@ -781,7 +825,8 @@ class Module(BaseModule):
         hyper_key = self._optimizer._hyperparam_key()
         self._fused_fit = {"step": step, "params": params, "states": states,
                            "names": names, "idx_of": idx_of,
-                           "hyper": hyper_key, "mesh": mesh, "z1": z1}
+                           "hyper": hyper_key, "mesh": mesh, "z1": z1,
+                           "stage": stage}
         return self._fused_fit
 
     def _fused_snapshot(self, exec_, names, idx_of, mesh, z1):

@@ -101,14 +101,38 @@ def ring_shift(x, axis_name, shift=1):
 #   where the reduce_scatter/all_gather pair is written out explicitly.
 
 def zero1_enabled(mesh: Optional[Mesh], axis_name: str = "data") -> bool:
-    """True when the ZeRO-1 sharded update should be used: a mesh with a
-    >1-sized axis_name and no MXNET_SHARDED_UPDATE=0 opt-out. Callers fall
-    back to the replicated update otherwise."""
+    """True when a ZeRO sharded update (any stage >= 1) should be used:
+    a mesh with a >1-sized axis_name and no MXNET_SHARDED_UPDATE=0
+    opt-out. Callers fall back to the replicated update otherwise."""
+    return sharded_stage(mesh, axis_name) >= 1
+
+
+def sharded_stage(mesh: Optional[Mesh], axis_name: str = "data") -> int:
+    """ZeRO stage selected by MXNET_SHARDED_UPDATE (Xu et al. + the
+    DeepSpeed/ZeRO staging taxonomy):
+
+      0  replicated update (opt-out)
+      1  optimizer state + master weights 1/N at rest; whole-tree weight
+         gather per step; gradients reduce-scattered at the end of backward
+      2  stage 1 + gradients reduce-scattered AS backward emits them
+         (bucketed, overlapping the remaining backward compute) — full
+         gradient-tree residency is never required
+      3  stage 2 + parameters stay 1/N at rest THROUGH the step: each leaf
+         is all-gathered on demand and re-gathered in backward (remat)
+         instead of held as a residual — param bytes/chip scale 1/N too
+
+    Default is stage 1 (the shipped ZeRO-1 behavior). 0 when there is no
+    mesh or the axis is trivial. Values clamp into [0, 3]."""
     if mesh is None:
-        return False
-    if os.environ.get("MXNET_SHARDED_UPDATE", "1") == "0":
-        return False
-    return int(dict(mesh.shape).get(axis_name, 0)) > 1
+        return 0
+    if int(dict(mesh.shape).get(axis_name, 0)) <= 1:
+        return 0
+    raw = os.environ.get("MXNET_SHARDED_UPDATE", "1")
+    try:
+        stage = int(raw)
+    except ValueError:
+        stage = 1
+    return max(0, min(3, stage))
 
 
 def zero1_partition_spec(shape, n_shards: int, axis_name: str = "data") -> P:
@@ -216,6 +240,235 @@ def zero1_update_local(w, g, update_fn, axis_name: str = "data",
     if pad:
         nf = nf[:size]
     return nf.reshape(w.shape).astype(w.dtype)
+
+
+# --- ZeRO-2: gradients sharded end-to-end -----------------------------------
+#
+# Stage 1 lets the full gradient tree materialize out of backward and only
+# then pins it to the 1/N layout (one constraint group after jax.vjp
+# returns). Stage 2 moves the reduce-scatter INTO backward: each parameter
+# leaf is wrapped in an identity whose custom cotangent rule constrains the
+# incoming gradient to the sharded layout, so the scatter for leaf L is
+# emitted adjacent to L's gradient producer and XLA's latency-hiding
+# scheduler overlaps it with the remaining backward compute. Small leaves
+# are grouped into flat buckets (MXNET_ZERO2_BUCKET_MB, default 4) so the
+# wire carries a few large collectives instead of many tiny ones — the
+# classic bucketed reduce-scatter. Values are untouched (layout only).
+
+ZERO2_BUCKET_MB_DEFAULT = 4.0
+
+
+def zero2_bucket_bytes() -> int:
+    try:
+        mb = float(os.environ.get("MXNET_ZERO2_BUCKET_MB",
+                                  str(ZERO2_BUCKET_MB_DEFAULT)))
+    except ValueError:
+        mb = ZERO2_BUCKET_MB_DEFAULT
+    return max(1, int(mb * 1024 * 1024))
+
+
+def _grad_ct_constrain(x, sharding):
+    """Identity whose COTANGENT is pinned to `sharding` — places the
+    gradient reduce-scatter at the leaf's grad-producer site in backward."""
+
+    @jax.custom_vjp
+    def ident(v):
+        return v
+
+    def fwd(v):
+        return v, None
+
+    def bwd(_, ct):
+        return (jax.lax.with_sharding_constraint(ct, sharding),)
+
+    ident.defvjp(fwd, bwd)
+    return ident(x)
+
+
+def _grad_ct_bucket(leaves, shardings, flat_sharding):
+    """Identity on a tuple of (same-dtype) leaves whose cotangents are
+    flattened, concatenated and constrained as ONE flat sharded bucket —
+    one collective for the whole group — then split back per leaf."""
+
+    @jax.custom_vjp
+    def ident(*vs):
+        return tuple(vs)
+
+    def fwd(*vs):
+        return tuple(vs), None
+
+    def bwd(_, cts):
+        flat = jnp.concatenate([jnp.ravel(c) for c in cts])
+        flat = jax.lax.with_sharding_constraint(flat, flat_sharding)
+        out, off = [], 0
+        for c, sh in zip(cts, shardings):
+            piece = jax.lax.dynamic_slice(flat, (off,), (c.size,))
+            off += c.size
+            out.append(jax.lax.with_sharding_constraint(
+                piece.reshape(c.shape), sh))
+        return tuple(out)
+
+    ident.defvjp(fwd, bwd)
+    return ident(*leaves)
+
+
+def zero2_grad_scatter(full, mesh: Mesh, axis_name: str = "data",
+                       bucket_bytes: Optional[int] = None):
+    """Wrap a dict of FULL (gathered) param leaves so backward emits
+    reduce-scattered gradient shards bucket-by-bucket as it runs. Returns
+    a dict with identical values; only the cotangent layout differs.
+    Bucket plan: reverse insertion order (~ backward emission order); a
+    leaf >= bucket_bytes scatters on its own, smaller leaves group into
+    flat same-dtype buckets up to bucket_bytes."""
+    if bucket_bytes is None:
+        bucket_bytes = zero2_bucket_bytes()
+    n = int(dict(mesh.shape)[axis_name])
+    flat_sh = NamedSharding(mesh, P(axis_name))
+    out = dict(full)
+    group: list = []
+    group_dtype = None
+    group_bytes = 0
+
+    def flush():
+        nonlocal group, group_dtype, group_bytes
+        if not group:
+            return
+        names = [nm for nm, _ in group]
+        leaves = [lv for _, lv in group]
+        shardings = [zero1_sharding(mesh, lv.shape, axis_name)
+                     for lv in leaves]
+        wrapped = _grad_ct_bucket(leaves, shardings, flat_sh)
+        for nm, w in zip(names, wrapped):
+            out[nm] = w
+        group, group_dtype, group_bytes = [], None, 0
+
+    for name in reversed(list(full)):
+        leaf = full[name]
+        nbytes = leaf.size * jnp.dtype(leaf.dtype).itemsize
+        if nbytes >= bucket_bytes:
+            out[name] = _grad_ct_constrain(
+                leaf, zero1_sharding(mesh, leaf.shape, axis_name))
+            continue
+        if group and (jnp.dtype(leaf.dtype) != group_dtype
+                      or group_bytes + nbytes > bucket_bytes):
+            flush()
+        group.append((name, leaf))
+        group_dtype = jnp.dtype(leaf.dtype)
+        group_bytes += nbytes
+    flush()
+    return out
+
+
+# --- ZeRO-3: parameters sharded at rest, gathered on demand -----------------
+#
+# The gather for each leaf runs INSIDE the differentiated function and is
+# tagged with checkpoint_name; the surrounding jax.checkpoint policy saves
+# every residual EXCEPT those tags, so backward re-gathers weights from the
+# 1/N shards instead of holding full-weight residuals across the step. The
+# gathered copy is therefore transient in both passes (freed after its
+# consumers), at the cost of a second gather in backward; XLA's
+# latency-hiding scheduler starts gather L+1 while layer L computes — the
+# one-layer prefetch.
+
+ZERO3_GATHER_NAME = "zero3_allgather"
+
+try:
+    from jax.ad_checkpoint import checkpoint_name as _checkpoint_name
+except ImportError:  # very old jax: lose the tag, keep the math
+    def _checkpoint_name(x, name):
+        return x
+
+
+def _zero3_gather_leaf(x, repl, grad_sharding):
+    """Per-leaf gather with an explicit cotangent rule: fwd gathers the
+    shard to full (tagged so the remat policy drops it from residuals);
+    bwd pins the incoming gradient straight to the 1/N layout — the
+    reduce-scatter happens AT the leaf's grad-producer site, never a full
+    replicated gradient (jax's default transpose of a sharding constraint
+    would re-replicate the cotangent)."""
+
+    @jax.custom_vjp
+    def gather(v):
+        return jax.lax.with_sharding_constraint(v, repl)
+
+    def fwd(v):
+        return jax.lax.with_sharding_constraint(v, repl), None
+
+    def bwd(_, ct):
+        return (jax.lax.with_sharding_constraint(ct, grad_sharding),)
+
+    gather.defvjp(fwd, bwd)
+    return _checkpoint_name(gather(x), ZERO3_GATHER_NAME)
+
+
+def zero3_gather(tree, mesh: Mesh, axis_name: str = "data"):
+    """In-jit per-leaf gather-on-demand (use INSIDE the function handed to
+    zero3_remat so the re-gather in backward and the remat policy both see
+    it). Gradients come back already in the 1/N layout."""
+    repl = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        lambda a: _zero3_gather_leaf(
+            a, repl, zero1_sharding(mesh, a.shape, axis_name)), tree)
+
+
+def zero3_remat(f):
+    """Wrap the fwd function so gathered weights are NOT saved as
+    residuals: policy saves anything except ZERO3_GATHER_NAME tags, so
+    the only backward recompute is the (re-)gathers themselves."""
+    try:
+        policy = jax.checkpoint_policies.save_any_names_but_these(
+            ZERO3_GATHER_NAME)
+    except AttributeError:  # old jax: fall back to saving residuals
+        return f
+    return jax.checkpoint(f, policy=policy)
+
+
+def stage_train_bytes(params, stage: int, n_shards: int,
+                      axis_name: str = "data",
+                      bucket_bytes: Optional[int] = None):
+    """(param_bytes, grad_bytes) per chip implied by the stage's LAYOUT
+    CONTRACT for one train step over `params` (dict name -> array-like).
+
+    This is the model behind the train_param_bytes / train_grad_bytes
+    gauges: what the program's sharding constraints bound, not a live
+    allocator reading (gradients are in-program transients).
+
+      params: stage <= 2 holds the whole gathered tree through fwd+bwd
+              (residuals); stage 3 holds the 1/N shards plus one transient
+              gathered leaf (remat frees each copy after use).
+      grads:  stage <= 1 lets the full tree materialize before the end-of-
+              backward scatter; stage >= 2 bounds residency by the shard
+              tree plus one in-flight bucket.
+
+    Leaves with no n-divisible dim stay replicated in every stage (the
+    zero1_partition_spec contract)."""
+    if bucket_bytes is None:
+        bucket_bytes = zero2_bucket_bytes()
+    full = 0
+    shard = 0
+    max_leaf = 0
+    for leaf in params.values():
+        nbytes = int(leaf.size * jnp.dtype(leaf.dtype).itemsize)
+        full += nbytes
+        max_leaf = max(max_leaf, nbytes)
+        if zero1_partition_spec(leaf.shape, n_shards, axis_name) == P():
+            shard += nbytes
+        else:
+            shard += nbytes // n_shards
+    if stage >= 3:
+        param_bytes = shard + max_leaf
+    elif stage >= 1:
+        param_bytes = full + shard
+    else:
+        param_bytes = full
+    if stage >= 2:
+        # in-flight transient: one bucket, or one big leaf scattering
+        # alone; never worse than the unsharded footprint (a bucket
+        # larger than the whole tree degenerates to stage-1 residency)
+        grad_bytes = min(full, shard + max(bucket_bytes, max_leaf))
+    else:
+        grad_bytes = full
+    return param_bytes, grad_bytes
 
 
 # --- host-level collectives over a mesh (imperative kvstore path) ---------
