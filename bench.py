@@ -1412,6 +1412,110 @@ def run_decode_paged_config():
     }
 
 
+def run_decode_spec_config():
+    """Speculative-decode A/B (BENCH_MODEL=decode, third record, ISSUE
+    16): the shared-system-prompt mix through arm S = the paged
+    scheduler with MXNET_DECODE_SPEC (int8 self-draft, k drafted tokens
+    per iteration, ONE fixed-shape verify) and arm V = the identical
+    paged scheduler decoding one token per step. Both arms are greedy
+    and their token streams are asserted IDENTICAL every repeat —
+    speculation preserves the target model's output exactly; it only
+    changes how many sequence positions one scheduler iteration
+    commits. The headline is therefore tokens/STEP from the scheduler's
+    own counters (step_tokens / seq_steps; vanilla is exactly 1.0 by
+    construction), the dispatch-bound quantity the ISSUE gates >= 2x —
+    wall-clock tokens/sec rides along as paired back-to-back ratios
+    (same idiom as the other decode records) for the curious, but on a
+    CPU-emulated tiny model the verify's k+1-wide matmuls cost nearly
+    as much as the lanes they replace, so the time ratio is reported,
+    not gated."""
+    import numpy as _np
+
+    from mxnet_tpu.serving.generate import DecodeScheduler, GenerateConfig
+
+    v = int(os.environ.get("BENCH_DECODE_VOCAB", "64"))
+    d = int(os.environ.get("BENCH_DECODE_DIM", "32"))
+    n_layers = int(os.environ.get("BENCH_DECODE_LAYERS", "2"))
+    h, hkv = 4, 2
+    n_streams = int(os.environ.get("BENCH_SPEC_STREAMS", "12"))
+    sys_len = int(os.environ.get("BENCH_SPEC_SYS", "25"))
+    new_tokens = int(os.environ.get("BENCH_SPEC_NEW", "12"))
+    k = int(os.environ.get("BENCH_SPEC_TOKENS", "4"))
+    repeats = max(1, int(os.environ.get("BENCH_SPEC_REPEATS", "5")))
+    block_tokens = int(os.environ.get("BENCH_SPEC_BLOCK_TOKENS", "8"))
+    max_context = int(os.environ.get("BENCH_SPEC_CTX", "64"))
+    slots = int(os.environ.get("BENCH_SPEC_SLOTS", "6"))
+
+    model = _decode_bench_model(v, d, n_layers, h, hkv)
+    rng = _np.random.RandomState(7)
+    sys_prompt = [int(t) for t in rng.randint(1, v, sys_len)]
+    prompts = [sys_prompt + [1 + (i % (v - 2))] for i in range(n_streams)]
+    prompt_len = len(prompts[0])
+    buckets = (4, 1 << (prompt_len - 1).bit_length())
+
+    def mk(spec):
+        return DecodeScheduler(model, GenerateConfig(
+            num_heads=h, num_kv_heads=hkv, slots=slots,
+            max_context=max_context, prefill_buckets=buckets,
+            max_new_tokens=new_tokens, queue_depth=max(64, 2 * n_streams),
+            paged=True, block_tokens=block_tokens, num_blocks=0,
+            prefix_share=True, spec=spec, spec_tokens=k,
+            spec_draft="int8"))
+
+    scheds = {True: mk(True), False: mk(False)}
+    for s in scheds.values():
+        s.start()
+
+    def arm(spec):
+        sched = scheds[spec]
+        t0 = time.perf_counter()
+        streams = [sched.submit(p, max_new_tokens=new_tokens)
+                   for p in prompts]
+        outs = [s.tokens(timeout=300.0) for s in streams]
+        dt = time.perf_counter() - t0
+        return sum(len(o) for o in outs) / dt, outs
+
+    # warmup compiles both program sets (spec: ladder + draft + verify)
+    arm(True)
+    arm(False)
+
+    spec_tps, base_tps, ratios = [], [], []
+    for _ in range(repeats):
+        tps_s, spec_outs = arm(True)
+        tps_v, base_outs = arm(False)
+        # greedy arms must emit the same computation's tokens — the
+        # rejection-sampling equivalence gate, asserted every repeat
+        assert spec_outs == base_outs, "spec/vanilla greedy arms diverged"
+        spec_tps.append(tps_s)
+        base_tps.append(tps_v)
+        ratios.append(tps_s / tps_v)
+    st_s = scheds[True].stats()
+    st_v = scheds[False].stats()
+    for s in scheds.values():
+        s.stop(drain=True)
+    tokens_per_step = st_s["step_tokens"] / max(1, st_s["seq_steps"])
+    accept_rate = st_s["accepted_tokens"] / max(1, st_s["drafted_tokens"])
+    return {
+        "metric": "decode_spec",
+        "value": round(tokens_per_step, 3),
+        "unit": "tokens_per_seq_step_vs_1_vanilla",
+        # the >= 2x tokens/step gate: >= 1.0 passes
+        "vs_baseline": round(tokens_per_step / 2.0, 3),
+        "accept_rate": round(accept_rate, 3),
+        "drafted_tokens": st_s["drafted_tokens"],
+        "accepted_tokens": st_s["accepted_tokens"],
+        "time_ratio_vs_vanilla": round(statistics.median(ratios), 3),
+        "spec_tokens_per_sec": round(statistics.median(spec_tps), 1),
+        "vanilla_tokens_per_sec": round(statistics.median(base_tps), 1),
+        "spec_compiles": st_s["compiles"],
+        "vanilla_compiles": st_v["compiles"],
+        "spec_k": k, "streams": n_streams, "new_tokens": new_tokens,
+        "prompt_len": prompt_len, "repeats": repeats,
+        "model": "LM V%d D%d L%dx%dh ctx%d" % (v, d, n_layers, h,
+                                               max_context),
+    }
+
+
 def run_quant_weight_config():
     """Quantized-weight decode A/B (BENCH_MODEL=quant, first record,
     ISSUE 14): the same generate workload through arm Q = the
@@ -1804,6 +1908,7 @@ def _main():
     if which == "decode":
         _emit(run_decode_config())
         _emit(run_decode_paged_config())
+        _emit(run_decode_spec_config())
         return
     if which == "quant":
         _emit(run_quant_weight_config())

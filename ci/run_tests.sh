@@ -42,7 +42,7 @@ echo "== stage 5: serving tests (dynamic batching + bucketed compile cache) =="
 # (batch former windows, deadlines, engine-dispatch pipelining), so it gets
 # its own stage where a hang or flake is attributable. Then the end-to-end
 # dry-run: concurrent clients -> occupancy/cache-hit assertions.
-JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py tests/test_serving_generate.py tests/test_paged_decode.py tests/test_quant.py -q
+JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py tests/test_serving_generate.py tests/test_paged_decode.py tests/test_quant.py tests/test_spec_decode.py -q
 # Both end-to-end dry-runs below run with the engine happens-before
 # sanitizer ON: the serving/decode dispatch paths must produce ZERO race
 # reports (docs/concurrency.md sanitizer section).
@@ -86,6 +86,19 @@ import __graft_entry__ as g; g.dryrun_quant()
 from mxnet_tpu import engine
 assert engine.sanitizer_reports() == [], engine.sanitizer_reports()
 print('sanitizer: 0 reports (quant)')"
+# Speculative-decoding gate (ISSUE 16): staggered greedy spec streams
+# (int8 self-draft, k=4, one fixed-shape verify) must be token-identical
+# to vanilla decode inside ladder+2 programs at >= 1.5 tokens committed
+# per scheduler step; sampled streams must match vanilla's per-position
+# token distributions over 160 fixed seeds (rejection-sampling
+# equivalence) and reproduce bitwise under the same seed; a warm restart
+# over the same progcache dir serves identical streams with ZERO fresh
+# compiles — all sanitizer-clean.
+JAX_PLATFORMS=cpu MXNET_ENGINE_SANITIZER=1 python -c "
+import __graft_entry__ as g; g.dryrun_spec()
+from mxnet_tpu import engine
+assert engine.sanitizer_reports() == [], engine.sanitizer_reports()
+print('sanitizer: 0 reports (spec)')"
 
 echo "== stage 6: import hygiene =="
 python - <<'EOF'

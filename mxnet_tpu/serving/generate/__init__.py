@@ -13,10 +13,12 @@ from .model import DecodeModel, DecodeSpec
 from .paged import PagedKVCacheManager
 from .programs import DecodePrograms, PagedDecodePrograms
 from .scheduler import DecodeScheduler, GenerateConfig
+from .spec import SpecDecoder, accept_greedy, accept_sampled, sample_token
 from .stream import TokenStream
 
 __all__ = [
     "AdmitPlan", "DecodeModel", "DecodeSpec", "DecodePrograms",
     "KVCacheManager", "PagedDecodePrograms", "PagedKVCacheManager",
-    "DecodeScheduler", "GenerateConfig", "TokenStream",
+    "DecodeScheduler", "GenerateConfig", "SpecDecoder", "TokenStream",
+    "accept_greedy", "accept_sampled", "sample_token",
 ]

@@ -112,6 +112,15 @@ class KVCacheManager:
         with self._lock:
             return int(self._lengths[slot])
 
+    def truncate(self, slot: int, new_len: int):
+        """Rewind ``slot``'s bookkeeping to ``new_len`` tokens — the
+        speculative-decode reject path. Slab rows past ``new_len`` keep
+        stale K/V, but every read is length-masked and the next verify
+        rewrites the window before any of it is unmasked, so the rewind
+        is this one host-side assignment."""
+        with self._lock:
+            self._lengths[slot] = int(new_len)
+
     def owner(self, slot: int):
         with self._lock:
             return self._owner[slot]

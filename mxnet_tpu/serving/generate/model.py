@@ -608,6 +608,220 @@ class DecodeModel:
 
         return decode
 
+    def build_verify(self, slots: int, capacity: int, window: int,
+                     kv_dtype: str = "float32"):
+        """Pure fn (params, k_slab, v_slab, lengths (B,) i32, wtokens
+        (B, W) i32) -> (logits (B, W, V), k_slab, v_slab) — the
+        speculative-decode verify program (serving/generate/spec.py).
+
+        A batched W-position forward per row: ``wtokens[i] = [last_token,
+        d_1 .. d_k]`` (W = k + 1 draft window) sits at absolute positions
+        ``lengths[i] + j``, attends to the row's cached prefix
+        (``prefix_cached_attention`` with per-row ctx_len — positions
+        >= lengths[i] in the slab are masked, so the draft pass's scratch
+        writes are invisible) plus causally to earlier window positions,
+        and every window position's k/v is scattered back into the slab —
+        OVERWRITING the draft model's scratch rows with target-exact
+        values, which is what makes rewind a pure length edit. Writes at
+        positions >= capacity are dropped (out-of-bounds scatter). Shapes
+        are independent of how many draft tokens end up accepted:
+        ``logits[i, j]`` is the target's next-token distribution after
+        sequence position ``lengths[i] + j``, and the host picks the
+        longest matching prefix / runs rejection sampling over it.
+
+        ``kv_dtype``: bf16 writes cast; int8 quantizes each window
+        position (same per-position scales as ``build_decode``) and feeds
+        the attention the quantized-then-dequantized values, so a window
+        position's own logits see exactly the cache bytes every later
+        step reads — the read-your-own-write discipline that keeps
+        accept-path streams bitwise equal to vanilla decode."""
+        spec = self.spec
+        act = getattr(self, "quant_act", "int8")
+        W = int(window)
+
+        def body(params, k_slab, v_slab, ks_slab, vs_slab, lengths,
+                 wtokens):
+            dm = params["embed"].shape[1]
+            n_layers = params["wq"].shape[0]
+            head_dim = dm // spec.num_heads
+            hkv = spec.hkv
+            lengths = lengths.astype(jnp.int32)
+            x = jnp.take(params["embed"], wtokens.astype(jnp.int32), axis=0)
+            pos = lengths[:, None] + jnp.arange(W, dtype=jnp.int32)  # (B, W)
+            rows = jnp.arange(slots, dtype=jnp.int32)[:, None]       # (B, 1)
+            rpos = pos[:, None, :]            # (B, 1, W): rope over heads
+            for l in range(n_layers):
+                h = _ln(x, params["ln1_g"][l], params["ln1_b"][l])
+                q = _mm(params, h, "wq", l, act).reshape(
+                    slots, W, spec.num_heads, head_dim).transpose(0, 2, 1, 3)
+                k_t = _mm(params, h, "wk", l, act).reshape(
+                    slots, W, hkv, head_dim).transpose(0, 2, 1, 3)
+                v_t = _mm(params, h, "wv", l, act).reshape(
+                    slots, W, hkv, head_dim).transpose(0, 2, 1, 3)
+                q = rope(q, positions=rpos, base=spec.rope_base)
+                k_t = rope(k_t, positions=rpos, base=spec.rope_base)
+                if ks_slab is not None:
+                    kq, k_s = _quantize_kv(k_t)   # scales (B, W)
+                    vq, v_s = _quantize_kv(v_t)
+                    k_slab = k_slab.at[l, rows, :, pos, :].set(
+                        kq.transpose(0, 2, 1, 3), mode="drop")
+                    v_slab = v_slab.at[l, rows, :, pos, :].set(
+                        vq.transpose(0, 2, 1, 3), mode="drop")
+                    ks_slab = ks_slab.at[l, rows, pos].set(k_s, mode="drop")
+                    vs_slab = vs_slab.at[l, rows, pos].set(v_s, mode="drop")
+                    # window keys as later reads will see them: quantized
+                    # then widened (dequantize_kv's math, in-register)
+                    k_win = kq.astype(jnp.float32) * k_s[:, None, :, None]
+                    v_win = vq.astype(jnp.float32) * v_s[:, None, :, None]
+                    att = prefix_cached_attention(
+                        q, k_slab[l], v_slab[l], lengths[:, None], k_win,
+                        v_win, k_scale=ks_slab[l], v_scale=vs_slab[l])
+                else:
+                    k_w = k_t.astype(k_slab.dtype)
+                    v_w = v_t.astype(v_slab.dtype)
+                    k_slab = k_slab.at[l, rows, :, pos, :].set(
+                        k_w.transpose(0, 2, 1, 3), mode="drop")
+                    v_slab = v_slab.at[l, rows, :, pos, :].set(
+                        v_w.transpose(0, 2, 1, 3), mode="drop")
+                    att = prefix_cached_attention(
+                        q, k_slab[l], v_slab[l], lengths[:, None], k_w, v_w)
+                att = att.transpose(0, 2, 1, 3).reshape(slots, W, dm)
+                x = x + _mm(params, att, "wo", l, act)
+                x = self._mlp_p(params, x, l, act)
+            logits = _mm(params, _ln(x, params["lnf_g"], params["lnf_b"]),
+                         "pred_w", None, act) + params["pred_b"]
+            if ks_slab is None:
+                return logits, k_slab, v_slab
+            return logits, k_slab, v_slab, ks_slab, vs_slab
+
+        if kv_dtype == "int8":
+            def verify(params, k_slab, v_slab, ks_slab, vs_slab, lengths,
+                       wtokens):
+                return body(params, k_slab, v_slab, ks_slab, vs_slab,
+                            lengths, wtokens)
+        else:
+            def verify(params, k_slab, v_slab, lengths, wtokens):
+                return body(params, k_slab, v_slab, None, None, lengths,
+                            wtokens)
+
+        return verify
+
+    def build_paged_verify(self, slots: int, block_tokens: int,
+                           max_blocks: int, window: int,
+                           kv_dtype: str = "float32"):
+        """Paged twin of ``build_verify``: (params, k_slab, v_slab,
+        tables (B, MB) i32, lengths (B,) i32, wtokens (B, W) i32) ->
+        (logits (B, W, V), k_slab, v_slab).
+
+        Window position ``lengths[i] + j`` scatters to physical block
+        ``tables[i, (lengths[i]+j) // T]`` offset ``% T`` — positions at
+        or past capacity, and positions beyond the row's block
+        reservation (table entry 0), land in trash block 0, never read
+        unmasked. The admission reservation already covers every position
+        a stream can ever COMMIT (``min(prompt + max_new, capacity)``),
+        so accepted tokens always land in reserved private blocks and the
+        speculative tail needs no allocation — rewind stays a host-side
+        length edit (``PagedKVCacheManager.truncate``)."""
+        spec = self.spec
+        act = getattr(self, "quant_act", "int8")
+        T = int(block_tokens)
+        mb = int(max_blocks)
+        cap = T * mb
+        W = int(window)
+
+        def body(params, k_slab, v_slab, ks_slab, vs_slab, tables,
+                 lengths, wtokens):
+            dm = params["embed"].shape[1]
+            n_layers = params["wq"].shape[0]
+            head_dim = dm // spec.num_heads
+            hkv = spec.hkv
+            lengths = lengths.astype(jnp.int32)
+            tables = tables.astype(jnp.int32)
+            x = jnp.take(params["embed"], wtokens.astype(jnp.int32), axis=0)
+            pos = lengths[:, None] + jnp.arange(W, dtype=jnp.int32)  # (B, W)
+            rpos = pos[:, None, :]
+            # write sites: clip is NOT enough here — clamping pos >= cap
+            # into the last table entry would wrap onto a REAL block, so
+            # out-of-range positions are routed to trash explicitly
+            phys = jnp.where(
+                pos < cap,
+                jnp.take_along_axis(tables,
+                                    jnp.clip(pos // T, 0, mb - 1), axis=1),
+                0)
+            off = pos % T
+            for l in range(n_layers):
+                h = _ln(x, params["ln1_g"][l], params["ln1_b"][l])
+                q = _mm(params, h, "wq", l, act).reshape(
+                    slots, W, spec.num_heads, head_dim).transpose(0, 2, 1, 3)
+                k_t = _mm(params, h, "wk", l, act).reshape(
+                    slots, W, hkv, head_dim).transpose(0, 2, 1, 3)
+                v_t = _mm(params, h, "wv", l, act).reshape(
+                    slots, W, hkv, head_dim).transpose(0, 2, 1, 3)
+                q = rope(q, positions=rpos, base=spec.rope_base)
+                k_t = rope(k_t, positions=rpos, base=spec.rope_base)
+                if ks_slab is not None:
+                    kq, k_s = _quantize_kv(k_t)   # scales (B, W)
+                    vq, v_s = _quantize_kv(v_t)
+                    k_slab = k_slab.at[l, phys, :, off, :].set(
+                        kq.transpose(0, 2, 1, 3))
+                    v_slab = v_slab.at[l, phys, :, off, :].set(
+                        vq.transpose(0, 2, 1, 3))
+                    ks_slab = ks_slab.at[l, phys, off].set(k_s)
+                    vs_slab = vs_slab.at[l, phys, off].set(v_s)
+                    k_win = kq.astype(jnp.float32) * k_s[:, None, :, None]
+                    v_win = vq.astype(jnp.float32) * v_s[:, None, :, None]
+                else:
+                    k_win = k_t.astype(k_slab.dtype)
+                    v_win = v_t.astype(v_slab.dtype)
+                    k_slab = k_slab.at[l, phys, :, off, :].set(
+                        k_win.transpose(0, 2, 1, 3))
+                    v_slab = v_slab.at[l, phys, :, off, :].set(
+                        v_win.transpose(0, 2, 1, 3))
+                # gather each row's dense ctx view through its table
+                # (write-first like build_paged_decode; the window span is
+                # masked by the per-row ctx_len anyway)
+                k_l = k_slab[l][tables].transpose(0, 2, 1, 3, 4) \
+                    .reshape(slots, hkv, cap, head_dim)
+                v_l = v_slab[l][tables].transpose(0, 2, 1, 3, 4) \
+                    .reshape(slots, hkv, cap, head_dim)
+                if ks_slab is not None:
+                    ks_l = ks_slab[l][tables].reshape(slots, cap)
+                    vs_l = vs_slab[l][tables].reshape(slots, cap)
+                    att = prefix_cached_attention(
+                        q, k_l, v_l, lengths[:, None], k_win, v_win,
+                        k_scale=ks_l, v_scale=vs_l)
+                else:
+                    att = prefix_cached_attention(
+                        q, k_l, v_l, lengths[:, None], k_win, v_win)
+                att = att.transpose(0, 2, 1, 3).reshape(slots, W, dm)
+                x = x + _mm(params, att, "wo", l, act)
+                x = self._mlp_p(params, x, l, act)
+            logits = _mm(params, _ln(x, params["lnf_g"], params["lnf_b"]),
+                         "pred_w", None, act) + params["pred_b"]
+            if ks_slab is None:
+                return logits, k_slab, v_slab
+            return logits, k_slab, v_slab, ks_slab, vs_slab
+
+        if kv_dtype == "int8":
+            def verify(params, k_slab, v_slab, ks_slab, vs_slab, tables,
+                       lengths, wtokens):
+                return body(params, k_slab, v_slab, ks_slab, vs_slab,
+                            tables, lengths, wtokens)
+        else:
+            def verify(params, k_slab, v_slab, tables, lengths, wtokens):
+                return body(params, k_slab, v_slab, None, None, tables,
+                            lengths, wtokens)
+
+        return verify
+
+    @staticmethod
+    def _mlp_p(params, x, l, act):
+        """``_mlp`` against explicit params (builders close over the
+        traced params argument, not ``self.params``)."""
+        h = _ln(x, params["ln2_g"][l], params["ln2_b"][l])
+        h = jax.nn.gelu(_mm(params, h, "w1", l, act) + params["b1"][l])
+        return x + (_mm(params, h, "w2", l, act) + params["b2"][l])
+
     def build_admit(self, slots: int, capacity: int,
                     kv_dtype: str = "float32"):
         """Pure fn (k_slab, v_slab, k_new (L,1,Hkv,C,Dh), v_new, slot i32)

@@ -97,6 +97,10 @@ class PagedKVCacheManager:
         self._free_blocks: deque = deque(range(1, self.num_blocks + 1))
         self._ref = np.zeros(self.num_blocks + 1, np.int32)
         self._tables = np.zeros((self.slots, self.max_blocks), np.int32)
+        # admission reservation in blocks, per slot: truncate() keeps
+        # table entries inside it by default (no-mid-stream-eviction —
+        # the sequence may grow back into them without allocating)
+        self._reserved = np.zeros(self.slots, np.int32)
         # prefix registry: chained hash -> full block id, and
         # chained hash -> (block id, partial token tuple); _block_keys is
         # the reverse map so a freed block unregisters its entries.
@@ -175,6 +179,7 @@ class PagedKVCacheManager:
             self._owner[slot] = owner
             self._lengths[slot] = n
             self._tables[slot] = table
+            self._reserved[slot] = nb
             if ctx_len > 0:
                 self.prefix_hits += 1
                 self.prefix_tokens_saved += ctx_len
@@ -239,6 +244,7 @@ class PagedKVCacheManager:
             self._tables[slot] = 0
             self._owner[slot] = None
             self._lengths[slot] = 0
+            self._reserved[slot] = 0
             self._free_slots.append(slot)
 
     # --- bookkeeping shared with the unpaged surface ----------------------
@@ -250,6 +256,45 @@ class PagedKVCacheManager:
     def length(self, slot: int) -> int:
         with self._lock:
             return int(self._lengths[slot])
+
+    def truncate(self, slot: int, new_len: int, release: bool = False):
+        """Rewind ``slot`` to ``new_len`` tokens — the speculative-decode
+        reject path, a pure block-table/length edit with no KV copies.
+
+        Table entries wholly past the new length are decref'd exactly
+        like ``free``: a refcounted shared-prefix block another sequence
+        still holds survives untouched, while a private speculative-tail
+        block drops to zero refs and returns to the pool. By default
+        entries inside the admission reservation are KEPT — the sequence
+        may grow back into them and must never allocate mid-stream;
+        ``release=True`` drops them too and shrinks the reservation
+        (explicit early-shrink, e.g. tests). Idempotent: a released
+        entry is already trash on the second call."""
+        T = self.block_tokens
+        new_len = int(new_len)
+        keep = -(-new_len // T)            # blocks still (partly) in use
+        with self._lock:
+            if self._owner[slot] is None:
+                return
+            self._lengths[slot] = new_len
+            floor = keep if release \
+                else max(keep, int(self._reserved[slot]))
+            if release and keep < self._reserved[slot]:
+                self._reserved[slot] = keep
+            table = self._tables[slot]
+            for idx in range(floor, self.max_blocks):
+                bid = int(table[idx])
+                if bid == TRASH_BLOCK:
+                    continue
+                table[idx] = TRASH_BLOCK
+                self._ref[bid] -= 1
+                if self._ref[bid] <= 0:
+                    self._ref[bid] = 0
+                    self._free_blocks.append(bid)
+                    for kind, key in self._block_keys.pop(bid, []):
+                        index = (self._full_index if kind == "full"
+                                 else self._partial_index)
+                        index.pop(key, None)
 
     def owner(self, slot: int):
         with self._lock:
@@ -297,6 +342,7 @@ class PagedKVCacheManager:
             self._free_blocks = deque(range(1, self.num_blocks + 1))
             self._ref[:] = 0
             self._tables[:] = 0
+            self._reserved[:] = 0
             self._full_index.clear()
             self._partial_index.clear()
             self._block_keys.clear()

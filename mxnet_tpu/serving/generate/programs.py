@@ -100,7 +100,8 @@ class DecodePrograms:
 
     def __init__(self, model: DecodeModel, slots: int, capacity: int,
                  prefill_buckets: Sequence[int],
-                 kv_dtype: str = "float32"):
+                 kv_dtype: str = "float32",
+                 step_model: Optional[DecodeModel] = None):
         buckets = sorted({int(b) for b in prefill_buckets})
         if not buckets:
             raise ServingError("decode: empty prefill bucket ladder")
@@ -112,6 +113,13 @@ class DecodePrograms:
             raise ServingError("decode: unknown kv_dtype %r (have %s)"
                                % (kv_dtype, sorted(KV_SLAB_DTYPES)))
         self.model = model
+        # the model whose forward IS the decode-step program. Defaults to
+        # ``model``; speculative decoding passes the DRAFT model here, so
+        # the vanilla 1-token step is never built — the draft step takes
+        # its slot in the program set and the verify program doubles as
+        # the target's step (accept-0 ≡ one vanilla step). That is what
+        # keeps the paged spec set at ladder + 2.
+        self.step_model = step_model or model
         self.slots = int(slots)
         self.capacity = int(capacity)
         self.buckets: List[int] = buckets
@@ -119,7 +127,10 @@ class DecodePrograms:
         self.compiles = 0    # fresh XLA compiles (the CI-gated bound)
         self.disk_hits = 0   # progcache warm loads
         self._params_avals = _avals(model.params)
+        self._step_params_avals = _avals(self.step_model.params)
         self._prefill: Dict[int, _Compiled] = {}
+        self._verify: Optional[_Compiled] = None
+        self.spec_window = 0
         elem = KV_SLAB_DTYPES[kv_dtype]
         slab = jax.ShapeDtypeStruct(
             model.kv_slab_shape(self.slots, self.capacity), elem)
@@ -135,9 +146,10 @@ class DecodePrograms:
             snew = jax.ShapeDtypeStruct(
                 model.kv_scale_slab_shape(1, self.capacity), jnp.float32)
             self._decode = _Compiled(
-                model.build_decode(self.slots, self.capacity, kv_dtype),
+                self.step_model.build_decode(self.slots, self.capacity,
+                                             kv_dtype),
                 donate=(1, 2, 3, 4), note="decode_step_kv_int8",
-                avals=(self._params_avals, slab, slab, sslab, sslab,
+                avals=(self._step_params_avals, slab, slab, sslab, sslab,
                        ints(self.slots), ints(self.slots)),
                 counters=self)
             self._admit = _Compiled(
@@ -148,11 +160,12 @@ class DecodePrograms:
                 counters=self)
         else:
             self._decode = _Compiled(
-                model.build_decode(self.slots, self.capacity, kv_dtype),
+                self.step_model.build_decode(self.slots, self.capacity,
+                                             kv_dtype),
                 donate=(1, 2),
                 note="decode_step" if kv_dtype == "float32"
                 else "decode_step_kv_%s" % kv_dtype,
-                avals=(self._params_avals, slab, slab,
+                avals=(self._step_params_avals, slab, slab,
                        ints(self.slots), ints(self.slots)),
                 counters=self)
             self._admit = _Compiled(
@@ -251,15 +264,68 @@ class DecodePrograms:
         """One step for every slot. ``lengths``/``tokens``: (slots,) i32
         (inactive slots: length 0, token 0 — lanes wasted, never wrong).
         Donates the slabs (and int8 scale slabs); use the returned ones.
-        Returns (logits, k, v) or (logits, k, v, ks, vs) for int8 KV."""
+        Returns (logits, k, v) or (logits, k, v, ks, vs) for int8 KV.
+        Runs ``step_model`` — the DRAFT model under speculative decoding,
+        identical to ``model`` otherwise."""
         if self.kv_dtype == "int8":
-            return self._decode(self.model.params, k_slab, v_slab,
+            return self._decode(self.step_model.params, k_slab, v_slab,
                                 ks_slab, vs_slab,
                                 jnp.asarray(lengths, jnp.int32),
                                 jnp.asarray(tokens, jnp.int32))
-        return self._decode(self.model.params, k_slab, v_slab,
+        return self._decode(self.step_model.params, k_slab, v_slab,
                             jnp.asarray(lengths, jnp.int32),
                             jnp.asarray(tokens, jnp.int32))
+
+    # --- speculative decode (serving/generate/spec.py) --------------------
+    def enable_verify(self, window: int):
+        """Build the ONE extra spec program: a fixed-shape W-position
+        verify forward of the TARGET model (W = spec_tokens + 1),
+        progcache-keyed like everything else. Idempotent per window."""
+        W = int(window)
+        if self._verify is not None and self.spec_window == W:
+            return
+        elem = KV_SLAB_DTYPES[self.kv_dtype]
+        slab = jax.ShapeDtypeStruct(
+            self.model.kv_slab_shape(self.slots, self.capacity), elem)
+        ints = jax.ShapeDtypeStruct((self.slots,), jnp.int32)
+        wtoks = jax.ShapeDtypeStruct((self.slots, W), jnp.int32)
+        if self.kv_dtype == "int8":
+            sslab = jax.ShapeDtypeStruct(
+                self.model.kv_scale_slab_shape(self.slots, self.capacity),
+                jnp.float32)
+            self._verify = _Compiled(
+                self.model.build_verify(self.slots, self.capacity, W,
+                                        self.kv_dtype),
+                donate=(1, 2, 3, 4),
+                note="decode_verify_w%d_kv_int8" % W,
+                avals=(self._params_avals, slab, slab, sslab, sslab, ints,
+                       wtoks),
+                counters=self)
+        else:
+            self._verify = _Compiled(
+                self.model.build_verify(self.slots, self.capacity, W,
+                                        self.kv_dtype),
+                donate=(1, 2),
+                note="decode_verify_w%d" % W if self.kv_dtype == "float32"
+                else "decode_verify_w%d_kv_%s" % (W, self.kv_dtype),
+                avals=(self._params_avals, slab, slab, ints, wtoks),
+                counters=self)
+        self.spec_window = W
+
+    def verify(self, k_slab, v_slab, lengths, wtokens, ks_slab=None,
+               vs_slab=None):
+        """Score a (slots, W) draft window against the TARGET model in one
+        program: returns (logits (B, W, V), k, v[, ks, vs]) with the
+        window's target-exact k/v scattered over the draft scratch
+        (slabs donated)."""
+        if self.kv_dtype == "int8":
+            return self._verify(self.model.params, k_slab, v_slab,
+                                ks_slab, vs_slab,
+                                jnp.asarray(lengths, jnp.int32),
+                                jnp.asarray(wtokens, jnp.int32))
+        return self._verify(self.model.params, k_slab, v_slab,
+                            jnp.asarray(lengths, jnp.int32),
+                            jnp.asarray(wtokens, jnp.int32))
 
     def admit(self, k_slab, v_slab, k_new, v_new, slot: int, ks_slab=None,
               vs_slab=None, ks_new=None, vs_new=None):
@@ -289,7 +355,8 @@ class PagedDecodePrograms(DecodePrograms):
 
     def __init__(self, model: DecodeModel, slots: int, capacity: int,
                  prefill_buckets: Sequence[int], block_tokens: int,
-                 num_blocks: int, kv_dtype: str = "float32"):
+                 num_blocks: int, kv_dtype: str = "float32",
+                 step_model: Optional[DecodeModel] = None):
         buckets = sorted({int(b) for b in prefill_buckets})
         if not buckets:
             raise ServingError("decode: empty prefill bucket ladder")
@@ -305,6 +372,7 @@ class PagedDecodePrograms(DecodePrograms):
             raise ServingError("decode: unknown kv_dtype %r (have %s)"
                                % (kv_dtype, sorted(KV_SLAB_DTYPES)))
         self.model = model
+        self.step_model = step_model or model    # draft model under spec
         self.slots = int(slots)
         self.capacity = int(capacity)
         self.buckets: List[int] = buckets
@@ -317,7 +385,10 @@ class PagedDecodePrograms(DecodePrograms):
         self.compiles = 0
         self.disk_hits = 0
         self._params_avals = _avals(model.params)
+        self._step_params_avals = _avals(self.step_model.params)
         self._prefill: Dict[int, _Compiled] = {}
+        self._verify: Optional[_Compiled] = None
+        self.spec_window = 0
         slab = jax.ShapeDtypeStruct(
             model.paged_slab_shape(self.num_blocks + 1, self.block_tokens),
             KV_SLAB_DTYPES[kv_dtype])
@@ -332,21 +403,23 @@ class PagedDecodePrograms(DecodePrograms):
                                              self.block_tokens),
                 jnp.float32)
             self._decode = _Compiled(
-                model.build_paged_decode(self.slots, self.block_tokens,
-                                         self.max_blocks, kv_dtype),
+                self.step_model.build_paged_decode(
+                    self.slots, self.block_tokens, self.max_blocks,
+                    kv_dtype),
                 donate=(1, 2, 3, 4), note="paged_decode_step_kv_int8",
-                avals=(self._params_avals, slab, slab, self._sslab_aval,
-                       self._sslab_aval, tables, ints(self.slots),
-                       ints(self.slots)),
+                avals=(self._step_params_avals, slab, slab,
+                       self._sslab_aval, self._sslab_aval, tables,
+                       ints(self.slots), ints(self.slots)),
                 counters=self)
         else:
             self._decode = _Compiled(
-                model.build_paged_decode(self.slots, self.block_tokens,
-                                         self.max_blocks, kv_dtype),
+                self.step_model.build_paged_decode(
+                    self.slots, self.block_tokens, self.max_blocks,
+                    kv_dtype),
                 donate=(1, 2),
                 note="paged_decode_step" if kv_dtype == "float32"
                 else "paged_decode_step_kv_%s" % kv_dtype,
-                avals=(self._params_avals, slab, slab, tables,
+                avals=(self._step_params_avals, slab, slab, tables,
                        ints(self.slots), ints(self.slots)),
                 counters=self)
         self._admit = None      # folded into the paged-prefill programs
@@ -448,14 +521,64 @@ class PagedDecodePrograms(DecodePrograms):
                ks_slab=None, vs_slab=None):
         """One step for every slot, indexed through the block tables.
         Donates the slabs; use the returned ones. int8 KV takes and
-        returns the scale slabs after the value slabs."""
+        returns the scale slabs after the value slabs. Runs
+        ``step_model`` (the draft under speculative decoding)."""
         if self.kv_dtype == "int8":
-            return self._decode(self.model.params, k_slab, v_slab,
+            return self._decode(self.step_model.params, k_slab, v_slab,
                                 ks_slab, vs_slab,
                                 jnp.asarray(tables, jnp.int32),
                                 jnp.asarray(lengths, jnp.int32),
                                 jnp.asarray(tokens, jnp.int32))
-        return self._decode(self.model.params, k_slab, v_slab,
+        return self._decode(self.step_model.params, k_slab, v_slab,
                             jnp.asarray(tables, jnp.int32),
                             jnp.asarray(lengths, jnp.int32),
                             jnp.asarray(tokens, jnp.int32))
+
+    def enable_verify(self, window: int):
+        """Paged spec verify: ladder + draft step + this = ladder + 2 —
+        the CI-gated spec program bound (there is no separate admit)."""
+        W = int(window)
+        if self._verify is not None and self.spec_window == W:
+            return
+        ints = jax.ShapeDtypeStruct((self.slots,), jnp.int32)
+        wtoks = jax.ShapeDtypeStruct((self.slots, W), jnp.int32)
+        tables = jax.ShapeDtypeStruct((self.slots, self.max_blocks),
+                                      jnp.int32)
+        if self.kv_dtype == "int8":
+            self._verify = _Compiled(
+                self.model.build_paged_verify(
+                    self.slots, self.block_tokens, self.max_blocks, W,
+                    self.kv_dtype),
+                donate=(1, 2, 3, 4),
+                note="paged_verify_w%d_kv_int8" % W,
+                avals=(self._params_avals, self._slab_aval,
+                       self._slab_aval, self._sslab_aval,
+                       self._sslab_aval, tables, ints, wtoks),
+                counters=self)
+        else:
+            self._verify = _Compiled(
+                self.model.build_paged_verify(
+                    self.slots, self.block_tokens, self.max_blocks, W,
+                    self.kv_dtype),
+                donate=(1, 2),
+                note="paged_verify_w%d" % W if self.kv_dtype == "float32"
+                else "paged_verify_w%d_kv_%s" % (W, self.kv_dtype),
+                avals=(self._params_avals, self._slab_aval,
+                       self._slab_aval, tables, ints, wtoks),
+                counters=self)
+        self.spec_window = W
+
+    def verify(self, k_slab, v_slab, tables, lengths, wtokens,
+               ks_slab=None, vs_slab=None):
+        """Target-model W-position verify through the block tables
+        (slabs donated) — see ``DecodePrograms.verify``."""
+        if self.kv_dtype == "int8":
+            return self._verify(self.model.params, k_slab, v_slab,
+                                ks_slab, vs_slab,
+                                jnp.asarray(tables, jnp.int32),
+                                jnp.asarray(lengths, jnp.int32),
+                                jnp.asarray(wtokens, jnp.int32))
+        return self._verify(self.model.params, k_slab, v_slab,
+                            jnp.asarray(tables, jnp.int32),
+                            jnp.asarray(lengths, jnp.int32),
+                            jnp.asarray(wtokens, jnp.int32))

@@ -50,6 +50,7 @@ from .kv_cache import KVCacheManager
 from .model import DecodeModel
 from .paged import PagedKVCacheManager
 from .programs import DecodePrograms, PagedDecodePrograms
+from .spec import SpecDecoder, sample_token
 from .stream import TokenStream
 
 
@@ -123,18 +124,36 @@ class GenerateConfig:
     quant_weights: str = dataclasses.field(
         default_factory=lambda: os.environ.get(
             "MXNET_QUANT_WEIGHT_DTYPE", ""))
+    # speculative decoding (PR 16): draft-k-then-verify. spec_tokens = k
+    # drafted per iteration; spec_draft picks the int8 self-draft
+    # ("int8", quantize_decode_model) or the same-precision model
+    # ("self" — the upper bound on acceptance, no quality gap)
+    spec: bool = dataclasses.field(
+        default_factory=lambda: _env_flag("MXNET_DECODE_SPEC", "0"))
+    spec_tokens: int = dataclasses.field(
+        default_factory=lambda: _env_int("MXNET_DECODE_SPEC_TOKENS", 4))
+    spec_draft: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "MXNET_DECODE_SPEC_DRAFT", "int8"))
 
 
 class _Active:
-    """One sequence occupying a slot."""
-    __slots__ = ("stream", "replica", "slot", "last_token", "generated")
+    """One sequence occupying a slot. ``temperature``/``rng`` carry the
+    per-stream sampling context (temperature 0 = greedy, rng unused —
+    a private RandomState per stream keeps draws deterministic per seed
+    and independent of scheduling order across streams)."""
+    __slots__ = ("stream", "replica", "slot", "last_token", "generated",
+                 "temperature", "rng")
 
-    def __init__(self, stream, replica, slot, last_token, generated):
+    def __init__(self, stream, replica, slot, last_token, generated,
+                 temperature=0.0, rng=None):
         self.stream = stream
         self.replica = replica
         self.slot = slot
         self.last_token = last_token
         self.generated = generated
+        self.temperature = temperature
+        self.rng = rng
 
 
 class DecodeScheduler:
@@ -152,18 +171,41 @@ class DecodeScheduler:
                 model, _quant.QuantConfig(
                     weight_dtype=config.quant_weights))
         self.model = model
+        draft = None
+        if config.spec:
+            if config.spec_tokens < 1:
+                raise ServingError("decode: spec_tokens must be >= 1")
+            if config.spec_draft not in ("int8", "self"):
+                raise ServingError(
+                    "decode: unknown spec_draft %r (want int8|self)"
+                    % config.spec_draft)
+            if config.spec_draft == "int8" \
+                    and "wq_scale" not in model.params:
+                draft = _quant.quantize_decode_model(
+                    model, _quant.QuantConfig(weight_dtype="int8"))
+            else:
+                # "self", or the target is already int8-quantized: the
+                # draft IS the target — the step program is then byte-
+                # identical to vanilla decode and shares its progcache
+                # entry
+                draft = model
         if config.paged:
             blocks = config.num_blocks or config.slots * (
                 -(-config.max_context // config.block_tokens))
             self.programs: DecodePrograms = PagedDecodePrograms(
                 model, config.slots, config.max_context,
                 config.prefill_buckets, config.block_tokens, blocks,
-                kv_dtype=kv_dtype)
+                kv_dtype=kv_dtype, step_model=draft)
         else:
             self.programs = DecodePrograms(model, config.slots,
                                            config.max_context,
                                            config.prefill_buckets,
-                                           kv_dtype=kv_dtype)
+                                           kv_dtype=kv_dtype,
+                                           step_model=draft)
+        self._spec: Optional[SpecDecoder] = None
+        if config.spec:
+            self.programs.enable_verify(config.spec_tokens + 1)
+            self._spec = SpecDecoder(self)
         self.replicas = int(replicas)
         self.caches: List[KVCacheManager] = []
         self._cond = threading.Condition()       # rank 50
@@ -173,6 +215,12 @@ class DecodeScheduler:
         self._thread: Optional[threading.Thread] = None
         self._captures: List[Optional[_engine.CapturedSequence]] = []
         self.steps = 0
+        # speculative-decode accounting (spec off: drafted stays 0 and
+        # step_tokens == seq_steps, i.e. tokens/step is exactly 1.0)
+        self.seq_steps = 0        # per-sequence step iterations
+        self.step_tokens = 0      # tokens emitted by step iterations
+        self.drafted_tokens = 0   # draft lanes eligible for acceptance
+        self.accepted_tokens = 0  # draft lanes the target accepted
         reg = _telemetry.registry
         self._m_tokens = reg.counter(
             "decode_tokens_total", help="tokens emitted by decode streams")
@@ -201,6 +249,16 @@ class DecodeScheduler:
             "decode_prefix_tokens_saved_total",
             help="prompt tokens served from shared prefix blocks "
                  "instead of being re-prefilled")
+        # explicit .set() from the scheduler loop, same staleness
+        # rationale as decode_batch_occupancy_pct above
+        self._m_accept_rate = reg.gauge(
+            "decode_spec_accept_rate",
+            help="speculative drafts accepted by the target model, "
+                 "fraction of drafted tokens (0 when spec is off)")
+        self._m_tokens_per_step = reg.gauge(
+            "decode_tokens_per_step",
+            help="tokens emitted per sequence per decode iteration "
+                 "(vanilla decode: exactly 1.0)")
 
     # --- lifecycle --------------------------------------------------------
     def start(self):
@@ -276,7 +334,13 @@ class DecodeScheduler:
     # --- submission -------------------------------------------------------
     def submit(self, prompt: Sequence[int],
                max_new_tokens: Optional[int] = None,
-               timeout_ms: Optional[float] = None) -> TokenStream:
+               timeout_ms: Optional[float] = None,
+               temperature: float = 0.0,
+               seed: Optional[int] = None) -> TokenStream:
+        """Queue one prompt. ``temperature`` 0 (default) is greedy —
+        bitwise the historical behavior; > 0 samples from the softmax
+        with a per-stream RandomState seeded by ``seed`` (deterministic
+        per seed, independent of co-resident streams)."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ServingError("empty prompt", code="too_large")
@@ -295,6 +359,8 @@ class DecodeScheduler:
         deadline = None if timeout_ms is None \
             else time.monotonic() + timeout_ms / 1000.0
         stream = TokenStream(len(prompt), max_new, deadline)
+        temperature = float(temperature)
+        rng = np.random.RandomState(seed) if temperature > 0.0 else None
         with self._cond:
             if self._state == "draining":
                 raise ServingError("server is draining",
@@ -304,7 +370,7 @@ class DecodeScheduler:
                                    code="shutdown")
             if len(self._queue) >= self.config.queue_depth:
                 raise ServingError("decode queue full", code="queue_full")
-            self._queue.append((stream, prompt))
+            self._queue.append((stream, prompt, temperature, rng))
             self._cond.notify_all()
         return stream
 
@@ -328,6 +394,12 @@ class DecodeScheduler:
             if self.config.paged and self.caches:
                 self._m_blocks_free.set(
                     sum(c.blocks_free() for c in self.caches))
+            if self.seq_steps:
+                self._m_tokens_per_step.set(
+                    self.step_tokens / self.seq_steps)
+            if self.drafted_tokens:
+                self._m_accept_rate.set(
+                    self.accepted_tokens / self.drafted_tokens)
 
     def _expire_and_cancel(self):
         now = time.monotonic()
@@ -393,12 +465,12 @@ class DecodeScheduler:
             with self._cond:
                 if not self._queue:
                     break
-                stream, prompt = self._queue.popleft()
+                stream, prompt, temp, rng = self._queue.popleft()
             cache = self.caches[rep]
             plan = cache.try_admit(stream, prompt, stream.max_new_tokens)
             if plan is None:      # slots/blocks exhausted — wait for
                 with self._cond:  # retirement, never evict mid-stream
-                    self._queue.appendleft((stream, prompt))
+                    self._queue.appendleft((stream, prompt, temp, rng))
                 break
             # build the bucket's prefill program here (scheduler thread)
             # so the engine op never mutates the program dict — two
@@ -408,7 +480,8 @@ class DecodeScheduler:
                 self._m_prefix_hits.inc()
                 self._m_prefix_saved.inc(plan.ctx_len)
             holder: Dict[str, object] = {}
-            admitted.append((_Active(stream, rep, plan.slot, 0, 0), holder))
+            admitted.append((_Active(stream, rep, plan.slot, 0, 0,
+                                     temperature=temp, rng=rng), holder))
             touched.append(cache.var)
 
             if self.config.paged:
@@ -420,7 +493,9 @@ class DecodeScheduler:
                             plan.fork_src, plan.fork_dst,
                             ks_slab=cache.k_scale, vs_slab=cache.v_scale)
                         cache.swap_slabs(*out[1:])
-                        holder["token"] = int(np.asarray(out[0]).argmax())
+                        # sampled post-fence on the scheduler thread —
+                        # the stream's rng is never touched off-thread
+                        holder["logits"] = np.asarray(out[0])
                     try:
                         with _telemetry.span(
                                 "decode.prefill", domain="serving",
@@ -457,7 +532,7 @@ class DecodeScheduler:
                                     cache.k_slab, cache.v_slab, k_new,
                                     v_new, plan.slot)
                             cache.swap_slabs(*out)
-                            holder["token"] = int(np.asarray(last).argmax())
+                            holder["logits"] = np.asarray(last)
                     except Exception as e:      # noqa: BLE001
                         holder["error"] = e
 
@@ -474,10 +549,17 @@ class DecodeScheduler:
                 continue
             with self._cond:
                 self._active[(a.replica, a.slot)] = a
-            self._emit(a, holder["token"])
+            self._emit(a, sample_token(holder["logits"], a.temperature,
+                                       a.rng))
 
-    def _emit(self, a: _Active, token: int):
-        """Deliver one sampled token and retire the sequence if done."""
+    def _emit(self, a: _Active, token: int, length: Optional[int] = None
+              ) -> bool:
+        """Deliver one sampled token; retire the sequence if done and
+        return False once it has retired (the speculative path stops
+        emitting a window's remaining tokens on eos). ``length`` is the
+        committed kv length AFTER this token's predecessor landed —
+        speculative emits pass it explicitly because the cache already
+        holds the whole accepted run."""
         a.last_token = token
         a.generated += 1
         a.stream._emit(token)
@@ -485,17 +567,28 @@ class DecodeScheduler:
         eos = self.config.eos_id
         if eos is not None and token == eos:
             self._retire(a, reason="eos")
-        elif a.generated >= a.stream.max_new_tokens:
+            return False
+        if a.generated >= a.stream.max_new_tokens:
             self._retire(a, reason="max_tokens")
-        elif self.caches[a.replica].length(a.slot) \
-                >= self.programs.capacity:
+            return False
+        if length is None:
+            length = self.caches[a.replica].length(a.slot)
+        if length >= self.programs.capacity:
             # the next step would write at kv position == capacity (the
             # write position IS the current length)
             self._retire(a, reason="capacity")
+            return False
+        return True
 
     def _step_all(self):
         """One decode step on every replica with occupied slots: push all
-        step ops, fence once, then sample/stream on the host."""
+        step ops, fence once, then sample/stream on the host. With
+        ``GenerateConfig.spec`` the iteration is the draft-k-then-verify
+        loop in spec.py instead (same push/fence/emit skeleton, 1..k+1
+        tokens per sequence)."""
+        if self._spec is not None:
+            self._spec.step_all()
+            return
         stepped = []          # (replica, [active...], holder)
         touched = []
         with self._cond:
@@ -562,7 +655,10 @@ class DecodeScheduler:
             logits = holder["logits"]
             for a in actives:
                 self.caches[rep].advance(a.slot)
-                self._emit(a, int(logits[a.slot].argmax()))
+                self.seq_steps += 1
+                self.step_tokens += 1
+                self._emit(a, sample_token(logits[a.slot], a.temperature,
+                                           a.rng))
 
     # --- introspection ----------------------------------------------------
     def stats(self) -> Dict[str, int]:
@@ -573,7 +669,14 @@ class DecodeScheduler:
               "disk_hits": self.programs.disk_hits,
               "steps": self.steps, "queued": queued, "active": active,
               "kv_dtype": self.kv_dtype,
-              "quant_weights": self.config.quant_weights or "off"}
+              "quant_weights": self.config.quant_weights or "off",
+              "seq_steps": self.seq_steps,
+              "step_tokens": self.step_tokens,
+              "drafted_tokens": self.drafted_tokens,
+              "accepted_tokens": self.accepted_tokens,
+              "spec": "%s k=%d" % (self.config.spec_draft,
+                                   self.config.spec_tokens)
+              if self.config.spec else "off"}
         if self.config.paged and self.caches:
             st["blocks_total"] = sum(c.blocks_total() for c in self.caches)
             st["blocks_free"] = sum(c.blocks_free() for c in self.caches)

@@ -408,14 +408,18 @@ class InferenceServer:
     # --- autoregressive decode (serving/generate) -------------------------
     def submit_stream(self, prompt: Sequence[int],
                       max_new_tokens: Optional[int] = None,
-                      timeout_ms: Optional[float] = None) -> TokenStream:
+                      timeout_ms: Optional[float] = None,
+                      temperature: float = 0.0,
+                      seed: Optional[int] = None) -> TokenStream:
         """Enqueue one generate request; returns a :class:`TokenStream`
         that yields token ids as the continuous-batching scheduler decodes
         them. ``timeout_ms`` is a whole-stream deadline (queued OR
         decoding; default none — decode requests outlive the fixed-path
-        ``timeout_ms`` scale by design). Raises ServingError with the
-        batcher's structured codes (``queue_full``, ``too_large``,
-        ``shutting_down``, ``shutdown``, ``deadline_exceeded``, ...)."""
+        ``timeout_ms`` scale by design). ``temperature`` 0 is greedy;
+        > 0 samples per-stream with a ``seed``-deterministic rng.
+        Raises ServingError with the batcher's structured codes
+        (``queue_full``, ``too_large``, ``shutting_down``, ``shutdown``,
+        ``deadline_exceeded``, ...)."""
         if self._decode is None:
             raise ServingError(
                 "decode is not configured — construct the server with "
@@ -426,18 +430,22 @@ class InferenceServer:
                           prompt=len(prompt))
         try:
             return self._decode.submit(prompt, max_new_tokens,
-                                       timeout_ms=timeout_ms)
+                                       timeout_ms=timeout_ms,
+                                       temperature=temperature, seed=seed)
         except ServingError as e:
             self.metrics.record_error(e.code)
             raise
 
     def generate(self, prompt: Sequence[int],
                  max_new_tokens: Optional[int] = None,
-                 timeout_ms: Optional[float] = None) -> List[int]:
+                 timeout_ms: Optional[float] = None,
+                 temperature: float = 0.0,
+                 seed: Optional[int] = None) -> List[int]:
         """Synchronous convenience: submit_stream + wait for the full
         token list."""
         stream = self.submit_stream(prompt, max_new_tokens,
-                                    timeout_ms=timeout_ms)
+                                    timeout_ms=timeout_ms,
+                                    temperature=temperature, seed=seed)
         wait = None if timeout_ms is None else timeout_ms / 1e3 + 60.0
         return stream.tokens(wait)
 
