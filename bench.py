@@ -649,6 +649,238 @@ def run_serving_config():
     }
 
 
+def run_serving_http_config():
+    """HTTP front-end hop A/B (BENCH_MODEL=serving_http, ISSUE 17).
+
+    value = the HTTP hop's p50 per-request cost (sequential p50 over
+    HTTP minus the same traffic's in-process ``submit`` p50 — the
+    delta is parse + route + admission + socket + the extra handler
+    thread hop, measured on an otherwise idle server to isolate the
+    hop) as % of the BATCH latency: the server-side p50 under the
+    canonical concurrent mix (16 threads of 33-row requests — batch
+    latency is a property of the loaded serving regime; a lone request
+    riding an empty 64-slot batch is the idle-server latency, not
+    batch latency). The request is the serving bench's canonical
+    33-row size in the raw-tensor b64 form (routes.
+    parse_predict_inputs: nested-list JSON float parsing alone costs
+    ~6 ms at 33x512, which would measure the wire format, not the hop;
+    p50_http_json_ms reports the list-form p50 for the SAME tensor
+    alongside). The ISSUE 17 gate is < 10%, so vs_baseline =
+    10 / overhead_pct (>= 1.0 passes; negative overhead = noise =
+    pass).
+
+    Alongside (not gated): goodput under a closed-loop 2x overload of
+    batch-class requests with shedding ON (shed_pct=25: excess is a
+    fast 429 at admission, the admitted subset stays near its unloaded
+    latency) vs OFF (shed_pct=100: everything queues and rides the
+    deep-queue latency past the SLO) — goodput counts only responses
+    inside an SLO of 4x the unloaded p50, per second of wall time."""
+    import http.client
+    import threading
+
+    import numpy as np
+    from mxnet_tpu import serving
+    from mxnet_tpu.serving.frontend import FrontendConfig, HttpFrontend
+
+    import base64
+
+    sym, params, in_dim, hidden, classes = _serving_model()
+    n = int(os.environ.get("BENCH_HTTP_REQUESTS", "160"))
+    rows = 33                  # the serving bench's canonical request
+    rng = np.random.RandomState(0)
+    x1 = rng.uniform(-1, 1, (rows, in_dim)).astype(np.float32)
+    body_b64 = json.dumps({"encoding": "b64", "inputs": {"data": {
+        "b64": base64.b64encode(np.ascontiguousarray(x1)).decode(),
+        "shape": [rows, in_dim], "dtype": "float32"}}})
+    body_json = json.dumps({"inputs": {"data": x1.tolist()}})
+
+    def mk(shed_pct):
+        srv = serving.InferenceServer(
+            sym, params, {"data": (in_dim,)},
+            config=serving.ServingConfig(buckets=(1, 8, 64), replicas=1,
+                                         warm=True, max_delay_ms=2.0,
+                                         queue_depth=64))
+        fe = HttpFrontend(srv, FrontendConfig(port=0, max_inflight=256,
+                                              shed_pct=shed_pct))
+        fe.start(wait_ready=True)
+        return fe, srv
+
+    def http_predict(conn, body, headers=None):
+        conn.request("POST", "/v1/predict", body,
+                     {"Content-Type": "application/json",
+                      **(headers or {})})
+        r = conn.getresponse()
+        r.read()
+        return r.status
+
+    # --- hop overhead: INTERLEAVED repeats, min-p50 per arm --------------
+    # CPU drift between two monolithic blocks swings the delta by more
+    # than the gate itself (the decode benches' min-vs-min idiom): each
+    # repeat measures both arms back to back and each arm takes the min
+    # of its per-repeat p50s
+    reps = max(1, int(os.environ.get("BENCH_HTTP_REPEATS", "3")))
+    fe, srv = mk(shed_pct=100.0)
+    conn = http.client.HTTPConnection("127.0.0.1", fe.port, timeout=60)
+    for _ in range(10):                                          # warm
+        srv.predict(data=x1)
+        assert http_predict(conn, body_b64) == 200
+
+    def block(fn, k):
+        lat = []
+        for _ in range(k):
+            t0 = time.perf_counter()
+            fn()
+            lat.append(time.perf_counter() - t0)
+        return float(np.percentile(lat, 50))
+
+    def http_ok(body):
+        st = http_predict(conn, body)
+        assert st == 200, st
+
+    p50s_in, p50s_http, p50s_json = [], [], []
+    for _ in range(reps):
+        p50s_in.append(block(lambda: srv.predict(data=x1), n))
+        p50s_http.append(block(lambda: http_ok(body_b64), n))
+        p50s_json.append(block(lambda: http_ok(body_json),
+                               max(8, n // 4)))
+    conn.close()
+    p50_in, p50_http, p50_json = (min(p50s_in), min(p50s_http),
+                                  min(p50s_json))
+    hop_ms = (p50_http - p50_in) * 1e3
+
+    # --- the denominator: batch latency under the canonical load ---------
+    # 16 concurrent HTTP clients of the same 33-row request; the server-
+    # side latency_ms_p50 (submit -> result) is the batch latency of the
+    # loaded regime the hop overhead is gated against
+    def loaded_client(i):
+        c = http.client.HTTPConnection("127.0.0.1", fe.port, timeout=120)
+        try:
+            for _ in range(max(4, n // 8)):
+                st = http_predict(c, body_b64)
+                assert st == 200, st
+        finally:
+            c.close()
+
+    srv.metrics.reset()
+    ts = [threading.Thread(target=loaded_client, args=(i,))
+          for i in range(16)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    m = dict(zip(*srv.get_metrics()))
+    batch_p50_ms = m["latency_ms_p50"]
+    overhead_pct = hop_ms / batch_p50_ms * 100.0
+
+    fe.stop(drain=True)
+
+    # --- goodput under 2x+ overload: shed on vs off ----------------------
+    # capacity is made definitional: buckets=(rows,) serves exactly ONE
+    # request per batch, so N closed-loop clients hold a queue of ~N-1
+    # and the per-request service time st sets all timescales. SLO =
+    # 8*st (a queue position <= ~7 meets it); shed-on caps the batch-
+    # class queue at 12.5% of queue_depth 32 = 4 (admitted requests ride
+    # a short queue and meet the SLO, the excess is a FAST 429), shed-
+    # off lets all N queue (everything rides an ~N-deep queue and
+    # misses). Speed-invariant: only queue-depth ratios matter.
+    n_clients = int(os.environ.get("BENCH_HTTP_OVERLOAD_CLIENTS", "24"))
+    per_client = 6
+
+    def mk_overload(shed_pct):
+        srv = serving.InferenceServer(
+            sym, params, {"data": (in_dim,)},
+            config=serving.ServingConfig(buckets=(rows,), replicas=1,
+                                         warm=True, max_delay_ms=2.0,
+                                         queue_depth=32,
+                                         timeout_ms=120000.0))
+        fe = HttpFrontend(srv, FrontendConfig(port=0, max_inflight=256,
+                                              shed_pct=shed_pct))
+        fe.start(wait_ready=True)
+        return fe
+
+    def overload(fe_port, slo_s):
+        lock = threading.Lock()
+        stat = {"good": 0, "late": 0, "shed": 0}
+
+        def client(i):
+            c = http.client.HTTPConnection("127.0.0.1", fe_port,
+                                           timeout=180)
+            try:
+                for _ in range(per_client):
+                    t0 = time.perf_counter()
+                    st = http_predict(c, body_b64,
+                                      headers={"x-priority": "batch"})
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        if st != 200:
+                            stat["shed"] += 1
+                        elif dt <= slo_s:
+                            stat["good"] += 1
+                        else:
+                            stat["late"] += 1
+            finally:
+                c.close()
+
+        t0 = time.perf_counter()
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(n_clients)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        wall = time.perf_counter() - t0
+        stat["goodput_rps"] = stat["good"] / wall
+        stat["wall_s"] = wall
+        return stat
+
+    def service_time_s(fe):
+        c = http.client.HTTPConnection("127.0.0.1", fe.port, timeout=60)
+        ref = []
+        for _ in range(12):
+            t0 = time.perf_counter()
+            st = http_predict(c, body_b64)
+            assert st == 200, st
+            ref.append(time.perf_counter() - t0)
+        c.close()
+        return float(np.percentile(ref, 50))
+
+    fe_on = mk_overload(shed_pct=12.5)
+    slo_s = 8.0 * service_time_s(fe_on)
+    on = overload(fe_on.port, slo_s)
+    fe_on.stop(drain=True)
+    fe_off = mk_overload(shed_pct=100.0)
+    off = overload(fe_off.port, slo_s)
+    fe_off.stop(drain=True)
+
+    return {
+        "metric": "serving_http",
+        "value": round(overhead_pct, 3),
+        "unit": "pct_http_hop_p50_of_loaded_batch_latency",
+        # the < 10% gate: >= 1.0 passes (negative overhead = noise)
+        "vs_baseline": round(10.0 / overhead_pct, 3)
+                       if overhead_pct > 0 else 99.0,
+        "hop_p50_ms": round(hop_ms, 3),
+        "batch_latency_p50_ms": round(batch_p50_ms, 3),
+        "p50_inprocess_ms": round(p50_in * 1e3, 3),
+        "p50_http_ms": round(p50_http * 1e3, 3),
+        "p50_http_json_ms": round(p50_json * 1e3, 3),
+        "request_rows": rows,
+        "requests": n,
+        "overload": {
+            "slo_ms": round(slo_s * 1e3, 1),
+            "clients": n_clients, "per_client": per_client,
+            "shed_on": {k: (round(v, 2) if isinstance(v, float) else v)
+                        for k, v in on.items()},
+            "shed_off": {k: (round(v, 2) if isinstance(v, float) else v)
+                         for k, v in off.items()},
+            "goodput_shed_on_vs_off": round(
+                on["goodput_rps"] / off["goodput_rps"], 3)
+                if off["goodput_rps"] else None,
+        },
+        "model": "MLP %d-%d-%d softmax" % (in_dim, hidden, classes),
+    }
+
+
 def run_engine_config():
     """Dispatch-overhead microbench (BENCH_MODEL=engine): host-side engine
     time per op, eager push vs captured/replayed submission, over a
@@ -1895,6 +2127,9 @@ def _main():
     which = os.environ.get("BENCH_MODEL", "both")
     if which == "serving":
         _emit(run_serving_config())
+        return
+    if which == "serving_http":
+        _emit(run_serving_http_config())
         return
     if which == "engine":
         _emit(run_engine_config())

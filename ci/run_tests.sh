@@ -42,7 +42,7 @@ echo "== stage 5: serving tests (dynamic batching + bucketed compile cache) =="
 # (batch former windows, deadlines, engine-dispatch pipelining), so it gets
 # its own stage where a hang or flake is attributable. Then the end-to-end
 # dry-run: concurrent clients -> occupancy/cache-hit assertions.
-JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py tests/test_serving_generate.py tests/test_paged_decode.py tests/test_quant.py tests/test_spec_decode.py -q
+JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py tests/test_serving_generate.py tests/test_paged_decode.py tests/test_quant.py tests/test_spec_decode.py tests/test_http_frontend.py -q
 # Both end-to-end dry-runs below run with the engine happens-before
 # sanitizer ON: the serving/decode dispatch paths must produce ZERO race
 # reports (docs/concurrency.md sanitizer section).
@@ -99,6 +99,14 @@ import __graft_entry__ as g; g.dryrun_spec()
 from mxnet_tpu import engine
 assert engine.sanitizer_reports() == [], engine.sanitizer_reports()
 print('sanitizer: 0 reports (spec)')"
+# HTTP front-end gate (ISSUE 17): a subprocess serves the predict +
+# generate front-ends; concurrent HTTP clients, a 2x overload burst that
+# must shed FAST with 429s (no queue-and-expire timeouts), a SIGTERM
+# mid-stream drain that drops zero tokens, and a warm restart over the
+# same progcache dir at ZERO fresh compiles with identical greedy
+# streams. MXNET_ENGINE_SANITIZER=1 is inherited by the serve arms.
+JAX_PLATFORMS=cpu MXNET_ENGINE_SANITIZER=1 \
+    python -c "import __graft_entry__ as g; g.dryrun_http()"
 
 echo "== stage 6: import hygiene =="
 python - <<'EOF'

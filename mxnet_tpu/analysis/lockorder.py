@@ -87,6 +87,12 @@ LOCK_HIERARCHY: Dict[str, int] = {
     # happen outside the hold.
     "serving.generate.paged.PagedKVCacheManager._lock": 100,
     "serving.generate.stream.TokenStream._cond": 100,
+    # HTTP admission gate: leaf — in-flight counter + draining flag only;
+    # the queue-depth policy reads (former._cond, rank 50) happen strictly
+    # outside the hold.
+    "serving.frontend.admission.AdmissionController._lock": 100,
+    # frontend stop() one-shot guard: leaf — a single flag flip under it.
+    "serving.frontend.server.HttpFrontend._stop_once": 100,
     # predictor run path: leaf — forward() holds it across the compiled
     # call but never acquires anything ranked inside.
     "predict.Predictor._run_lock": 100,

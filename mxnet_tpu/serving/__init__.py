@@ -26,8 +26,10 @@ the same server: continuous batching with iteration-level scheduling
         out = req.get(timeout=1.0)
     print(srv.metrics.get_name_value())
 """
-from .batcher import BatchFormer, Request, ServingError
+from .batcher import (PRIORITY_BATCH, PRIORITY_INTERACTIVE, BatchFormer,
+                      Request, ServingError)
 from .bucket_cache import BucketCache
+from .frontend import FrontendConfig, HttpFrontend
 from .generate import (DecodeModel, DecodePrograms, DecodeScheduler,
                        DecodeSpec, GenerateConfig, KVCacheManager,
                        PagedDecodePrograms, PagedKVCacheManager,
@@ -39,6 +41,8 @@ from .tuner import BucketTuner
 
 __all__ = [
     "BatchFormer", "Request", "ServingError", "BucketCache",
+    "PRIORITY_INTERACTIVE", "PRIORITY_BATCH",
+    "FrontendConfig", "HttpFrontend",
     "ServingBatchEndParam", "ServingMetrics", "InferenceServer",
     "ServingConfig", "create_server", "StagingPool", "BucketTuner",
     "DecodeModel", "DecodeSpec", "DecodePrograms", "KVCacheManager",

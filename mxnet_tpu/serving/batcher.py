@@ -47,19 +47,35 @@ class ServingError(MXNetError):
         self.code = code
 
 
+#: priority/QoS classes, in admission order: interactive requests are
+#: always dispatched before batch-class requests queued at the same time
+#: (FIFO within a class — a class never reorders internally)
+PRIORITY_INTERACTIVE = 0
+PRIORITY_BATCH = 1
+_N_PRIORITIES = 2
+
+
 class Request:
     """One in-flight request: a dict of name -> np.ndarray with a leading
     batch axis (usually 1 row; small batches ride whole — the former never
-    splits a request across micro-batches)."""
+    splits a request across micro-batches). ``priority`` is the QoS class
+    (``PRIORITY_INTERACTIVE``/``PRIORITY_BATCH``); ``request_id`` is an
+    opaque caller correlation id echoed by the HTTP front-end."""
 
     __slots__ = ("inputs", "rows", "deadline", "submitted", "latency_ms",
-                 "_event", "_outputs", "_error")
+                 "priority", "request_id", "_event", "_outputs", "_error")
 
     def __init__(self, inputs: Dict[str, np.ndarray], rows: int,
-                 deadline: Optional[float]):
+                 deadline: Optional[float], priority: int = 0,
+                 request_id: Optional[str] = None):
         self.inputs = inputs
         self.rows = rows
         self.deadline = deadline          # time.monotonic() absolute, or None
+        if not 0 <= int(priority) < _N_PRIORITIES:
+            raise ServingError("priority must be 0 (interactive) or 1 "
+                               "(batch), got %r" % (priority,))
+        self.priority = int(priority)
+        self.request_id = request_id
         self.submitted = time.monotonic()
         self.latency_ms: Optional[float] = None
         self._event = threading.Event()
@@ -99,12 +115,25 @@ class BatchFormer:
     """Bounded request queue + micro-batch former.
 
     ``submit`` is the backpressure point: a full queue rejects immediately
-    (the caller sheds load or retries) rather than buffering unboundedly.
+    (the caller sheds load or retries) rather than buffering unboundedly,
+    and a request whose deadline is already infeasible — given queued rows
+    and the recent dispatch-latency EWMA fed by ``note_dispatch`` — is
+    rejected at submit time with ``deadline_exceeded`` instead of being
+    queued only to expire in the FIFO (reject-early beats queue-and-expire
+    under overload: the client learns NOW, and the queue carries only work
+    that can still meet its deadline).
     ``next_batch`` is the worker side: blocks for traffic, then holds the
     window open up to ``max_delay_ms`` past the OLDEST queued request's
     arrival while rows accumulate toward ``max_batch``. Expired requests
     are failed (``deadline_exceeded``) at pop time and do not poison the
     batch — the queue keeps draining.
+
+    Priority/QoS: two admission classes (``Request.priority`` —
+    interactive 0, batch 1). The former packs interactive requests first;
+    batch-class requests ride only in the remaining row budget. Each class
+    keeps FIFO order internally, and the delay window still opens from the
+    oldest queued request regardless of class, so batch work is deferred
+    under load but never starved while the queue drains.
     """
 
     def __init__(self, max_batch: int, max_delay_ms: float = 2.0,
@@ -125,16 +154,55 @@ class BatchFormer:
         # under adaptive tuning); coalesce_fill == 0 disables the policy.
         self._buckets_fn = buckets_fn
         self.coalesce_fill = float(coalesce_fill)
-        self._q: deque = deque()
-        self._rows = 0  # queued rows (cached sum over self._q)
+        # one FIFO per priority class; all guarded by _cond
+        self._qs = tuple(deque() for _ in range(_N_PRIORITIES))
+        self._rows = 0  # queued rows (cached sum over self._qs)
         self._cond = threading.Condition()
         self._closed = False
         self._close_code = "shutdown"  # what post-close submits raise
+        # reject-early feasibility estimate: EWMA of recent dispatch
+        # service time (seconds per micro-batch), fed by note_dispatch
+        # from the server's dispatch tail; parallelism = replica count
+        # (concurrent dispatches divide the backlog drain time)
+        self._ewma_batch_s = 0.0
+        self._ewma_n = 0
+        self.parallelism = 1
 
     def _fail(self, req: Request, err: ServingError):
         req.set_error(err)
         if self._error_hook is not None:
             self._error_hook(err.code)
+
+    def note_dispatch(self, seconds: float):
+        """Feed one observed dispatch service time (seconds from batch
+        handoff to results published) into the reject-early EWMA. Called
+        by the server's dispatch tail from an engine worker — a leaf-style
+        touch of ``_cond`` with nothing else held."""
+        if seconds < 0:
+            return
+        with self._cond:
+            if self._ewma_n == 0:
+                self._ewma_batch_s = float(seconds)
+            else:
+                self._ewma_batch_s += 0.2 * (float(seconds)
+                                             - self._ewma_batch_s)
+            self._ewma_n += 1
+
+    def dispatch_ewma_s(self) -> float:
+        """Recent dispatch service-time estimate (0.0 until warmed)."""
+        with self._cond:
+            return self._ewma_batch_s if self._ewma_n else 0.0
+
+    def _eta_s_locked(self, rows: int) -> Optional[float]:
+        """Estimated seconds until a request of ``rows`` submitted NOW
+        would finish dispatching, or None when the EWMA isn't warm yet
+        (< 3 samples — never reject on a cold estimate). Caller holds
+        ``_cond``."""
+        if self._ewma_n < 3:
+            return None
+        backlog = self._rows + rows
+        batches = -(-backlog // self.max_batch)  # ceil
+        return batches * self._ewma_batch_s / max(1, self.parallelism)
 
     def submit(self, req: Request):
         if req.rows > self.max_batch:
@@ -142,24 +210,39 @@ class BatchFormer:
                 "request of %d rows exceeds max_batch (%d); split it or "
                 "raise the largest bucket" % (req.rows, self.max_batch),
                 "too_large")
+        now = time.monotonic()
         with self._cond:
             if self._closed:
                 raise ServingError(
                     "server is shut down" if self._close_code == "shutdown"
                     else "server is draining for shutdown",
                     self._close_code)
-            if len(self._q) >= self.queue_depth:
+            depth = sum(len(q) for q in self._qs)
+            if depth >= self.queue_depth:
                 raise ServingError(
                     "queue full (%d requests; MXNET_SERVING_QUEUE_DEPTH)"
-                    % len(self._q), "queue_full")
-            self._q.append(req)
+                    % depth, "queue_full")
+            if req.deadline is not None:
+                # reject-early: never enqueue work that cannot make its
+                # deadline given the queued-rows backlog and the recent
+                # dispatch EWMA. Gated on a WARM estimate (>= 3 samples):
+                # a cold former keeps the historical pop-time expiry path
+                # so the contract is unchanged until real latencies exist.
+                eta = self._eta_s_locked(req.rows)
+                if eta is not None and now + eta >= req.deadline:
+                    raise ServingError(
+                        "deadline infeasible at submit: ~%.1f ms of queued "
+                        "work ahead, %.1f ms budget left"
+                        % (eta * 1e3, (req.deadline - now) * 1e3),
+                        "deadline_exceeded")
+            self._qs[req.priority].append(req)
             self._rows += req.rows
             self._cond.notify()
 
     def depth(self) -> int:
         """Queued (not yet dispatched) request count — the live gauge."""
         with self._cond:
-            return len(self._q)
+            return sum(len(q) for q in self._qs)
 
     def closed(self) -> bool:
         with self._cond:
@@ -178,7 +261,10 @@ class BatchFormer:
                      msg: str = "server stopped with the request queued"):
         """Fail every queued request (post-close, non-draining stop)."""
         with self._cond:
-            pending, self._q, self._rows = list(self._q), deque(), 0
+            pending = [r for q in self._qs for r in q]
+            for q in self._qs:
+                q.clear()
+            self._rows = 0
         for r in pending:
             self._fail(r, ServingError(msg, code))
 
@@ -212,33 +298,44 @@ class BatchFormer:
                 self._buckets_fn is not None and self.coalesce_fill > 0
             ) else None
             with self._cond:
-                while not self._q and not self._closed:
+                while not any(self._qs) and not self._closed:
                     self._cond.wait()
-                if not self._q and self._closed:
+                if not any(self._qs) and self._closed:
                     return None
-                # hold the window open from the head request's arrival;
-                # closed => dispatch whatever is queued immediately
-                t_end = self._q[0].submitted + self.max_delay
+                # hold the window open from the OLDEST head request's
+                # arrival regardless of class (a queued batch-class request
+                # still bounds its wait); closed => dispatch immediately
+                t_end = min(q[0].submitted for q in self._qs if q) \
+                    + self.max_delay
                 while (self._rows < self.max_batch and not self._closed):
                     remaining = t_end - time.monotonic()
                     if remaining <= 0:
                         break
                     self._cond.wait(remaining)
                 target = self._pack_target(ladder)
-                batch, rows, now = [], 0, time.monotonic()
-                while self._q:
-                    req = self._q[0]
-                    if req.expired(now):
-                        self._q.popleft()
+                batch, rows, now, full = [], 0, time.monotonic(), False
+                # admission order: interactive class drains first; batch
+                # class rides in the leftover row budget (FIFO per class).
+                # The first non-fitting head stops packing entirely — a
+                # lower class must not slip past it into this micro-batch
+                # (priority inversion); the next micro-batch takes it.
+                for q in self._qs:
+                    while q and not full:
+                        req = q[0]
+                        if req.expired(now):
+                            q.popleft()
+                            self._rows -= req.rows
+                            expired.append(req)
+                            continue
+                        if rows + req.rows > target and batch:
+                            full = True
+                            break  # next micro-batch takes it
+                        q.popleft()
                         self._rows -= req.rows
-                        expired.append(req)
-                        continue
-                    if rows + req.rows > target and batch:
-                        break  # next micro-batch takes it
-                    self._q.popleft()
-                    self._rows -= req.rows
-                    batch.append(req)
-                    rows += req.rows
+                        batch.append(req)
+                        rows += req.rows
+                    if full:
+                        break
             # fail outside _cond: the error hook may take other locks
             # (e.g. ServingMetrics._lock, whose holder may call depth())
             for req in expired:

@@ -336,11 +336,14 @@ class DecodeScheduler:
                max_new_tokens: Optional[int] = None,
                timeout_ms: Optional[float] = None,
                temperature: float = 0.0,
-               seed: Optional[int] = None) -> TokenStream:
+               seed: Optional[int] = None,
+               request_id: Optional[str] = None) -> TokenStream:
         """Queue one prompt. ``temperature`` 0 (default) is greedy —
         bitwise the historical behavior; > 0 samples from the softmax
         with a per-stream RandomState seeded by ``seed`` (deterministic
-        per seed, independent of co-resident streams)."""
+        per seed, independent of co-resident streams). ``request_id``
+        is carried on the TokenStream and annotated on decode spans so
+        an HTTP SSE stream correlates with scheduler work."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ServingError("empty prompt", code="too_large")
@@ -358,7 +361,8 @@ class DecodeScheduler:
                                code="too_large")
         deadline = None if timeout_ms is None \
             else time.monotonic() + timeout_ms / 1000.0
-        stream = TokenStream(len(prompt), max_new, deadline)
+        stream = TokenStream(len(prompt), max_new, deadline,
+                             request_id=request_id)
         temperature = float(temperature)
         rng = np.random.RandomState(seed) if temperature > 0.0 else None
         with self._cond:
@@ -485,7 +489,8 @@ class DecodeScheduler:
             touched.append(cache.var)
 
             if self.config.paged:
-                def op(cache=cache, plan=plan, holder=holder):
+                def op(cache=cache, plan=plan, holder=holder,
+                       rid=stream.request_id):
                     def run():
                         out = self.programs.paged_prefill(
                             cache.k_slab, cache.v_slab, plan.table,
@@ -500,7 +505,7 @@ class DecodeScheduler:
                         with _telemetry.span(
                                 "decode.prefill", domain="serving",
                                 tokens=len(plan.suffix),
-                                reused=plan.ctx_len):
+                                reused=plan.ctx_len, request_id=rid):
                             if plan.forked:
                                 with _telemetry.span(
                                         "decode.cow_fork", domain="serving",
@@ -512,11 +517,13 @@ class DecodeScheduler:
                     except Exception as e:      # noqa: BLE001
                         holder["error"] = e
             else:
-                def op(cache=cache, plan=plan, holder=holder):
+                def op(cache=cache, plan=plan, holder=holder,
+                       rid=stream.request_id):
                     try:
                         with _telemetry.span("decode.prefill",
                                              domain="serving",
-                                             tokens=len(plan.suffix)):
+                                             tokens=len(plan.suffix),
+                                             request_id=rid):
                             pre = self.programs.prefill(plan.suffix)
                             if len(pre) == 5:   # int8 KV: + scale rows
                                 last, k_new, v_new, ks_new, vs_new = pre

@@ -248,6 +248,9 @@ class InferenceServer:
             error_hook=self.metrics.record_error,
             buckets_fn=lambda: self._ladder,
             coalesce_fill=self.config.coalesce_fill_pct / 100.0)
+        # replica count divides the reject-early backlog estimate:
+        # dispatches to different replicas run concurrently
+        former.parallelism = len(self._replicas)
         self.metrics._queue_depth_fn = former.depth
         return former
 
@@ -354,11 +357,19 @@ class InferenceServer:
 
     # --- client surface ---------------------------------------------------
     def submit(self, timeout_ms: Optional[float] = None,
+               priority: object = 0,
+               request_id: Optional[str] = None,
                **inputs) -> Request:
         """Enqueue one request (arrays WITH a leading batch axis; 1-row
         requests are the common case). Returns a Request future —
         ``req.get()`` blocks for the result. Raises ServingError
-        immediately on backpressure (``queue_full``) or shutdown."""
+        immediately on backpressure (``queue_full``), an infeasible
+        deadline (``deadline_exceeded`` — reject-early) or shutdown.
+        ``priority`` is the QoS class — ``"interactive"``/0 (default,
+        dispatched first) or ``"batch"``/1 (rides in leftover batch
+        budget). ``request_id`` is an opaque correlation id carried on
+        the Request and telemetry."""
+        pri = {"interactive": 0, "batch": 1}.get(priority, priority)
         rows = None
         feed = {}
         for name in self._input_names:
@@ -385,8 +396,10 @@ class InferenceServer:
                 % (rows, max_rows), "too_large")
         t = self.config.timeout_ms if timeout_ms is None else timeout_ms
         deadline = (time.monotonic() + t / 1e3) if t and t > 0 else None
-        req = Request(feed, rows, deadline)
-        telemetry.instant("serving.submit", domain="serving", rows=rows)
+        req = Request(feed, rows, deadline, priority=pri,
+                      request_id=request_id)
+        telemetry.instant("serving.submit", domain="serving", rows=rows,
+                          priority=req.priority, request_id=request_id)
         self.metrics.record_submit(rows)
         try:
             self._former.submit(req)
@@ -410,7 +423,8 @@ class InferenceServer:
                       max_new_tokens: Optional[int] = None,
                       timeout_ms: Optional[float] = None,
                       temperature: float = 0.0,
-                      seed: Optional[int] = None) -> TokenStream:
+                      seed: Optional[int] = None,
+                      request_id: Optional[str] = None) -> TokenStream:
         """Enqueue one generate request; returns a :class:`TokenStream`
         that yields token ids as the continuous-batching scheduler decodes
         them. ``timeout_ms`` is a whole-stream deadline (queued OR
@@ -427,11 +441,12 @@ class InferenceServer:
         if not self._started:
             raise ServingError("server not started", "shutdown")
         telemetry.instant("serving.submit_stream", domain="serving",
-                          prompt=len(prompt))
+                          prompt=len(prompt), request_id=request_id)
         try:
             return self._decode.submit(prompt, max_new_tokens,
                                        timeout_ms=timeout_ms,
-                                       temperature=temperature, seed=seed)
+                                       temperature=temperature, seed=seed,
+                                       request_id=request_id)
         except ServingError as e:
             self.metrics.record_error(e.code)
             raise
@@ -673,6 +688,7 @@ class InferenceServer:
         sp = telemetry.span("serving.dispatch", domain="serving",
                             nbatch=nbatch, replica=rep.index)
         sp.__enter__()
+        t0 = time.monotonic()
         try:
             rows = sum(r.rows for r in batch)
             # choose-and-fetch under one cache lock hold: atomic against a
@@ -708,6 +724,10 @@ class InferenceServer:
                                 bucket=bucket):
                 outs = [o.asnumpy() for o in exe.forward(**feed)]
             self._publish_outputs(batch, rep, nbatch, bucket, rows, outs)
+            # feed the reject-early estimator with the observed service
+            # time (handoff -> results published); successes only, so a
+            # failure storm doesn't poison the feasibility EWMA
+            self._former.note_dispatch(time.monotonic() - t0)
         except BaseException as e:
             err = e if isinstance(e, ServingError) else ServingError(
                 "dispatch failed: %s: %s" % (type(e).__name__, e),
@@ -753,6 +773,27 @@ class InferenceServer:
                 logging.getLogger("mxnet_tpu").exception(
                     "serving batch_end_callback raised (batch %d)",
                     nbatch)
+
+    # --- readiness --------------------------------------------------------
+    def warm(self):
+        """Compile (or progcache-disk-load) every rung of every replica's
+        ladder now. Idempotent; the HTTP front-end calls it from a
+        background thread so ``/readyz`` flips only once no request can
+        hit a cold compile."""
+        for rep in self._replicas:
+            rep.cache.warm()
+
+    def ready(self) -> bool:
+        """True once the server is started AND every replica holds a
+        program for every rung of the live ladder — the ``/readyz``
+        predicate: traffic admitted now will not stall on a compile."""
+        if not self._started:
+            return False
+        for rep in self._replicas:
+            s = rep.cache.stats()
+            if not set(s["buckets"]) <= set(s["compiled"]):
+                return False
+        return True
 
     # --- introspection ----------------------------------------------------
     def get_metrics(self):

@@ -20,11 +20,13 @@ from .tracer import (begin, chrome_events, clock_ns, complete,
                      disable_spans, drain_events, enable_spans, enabled,
                      enabled_domains, end, instant, mark_begin, mark_end,
                      reset, span)
-from .metrics import Counter, Gauge, Histogram, Registry, registry
+from .metrics import (CONTENT_TYPE_LATEST, Counter, Gauge, Histogram,
+                      Registry, registry)
 
 __all__ = [
     "span", "begin", "end", "complete", "instant", "mark_begin", "mark_end",
     "enabled", "enable_spans", "disable_spans", "enabled_domains",
     "drain_events", "chrome_events", "clock_ns", "reset",
     "registry", "Registry", "Counter", "Gauge", "Histogram",
+    "CONTENT_TYPE_LATEST",
 ]

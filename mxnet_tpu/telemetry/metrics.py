@@ -26,6 +26,11 @@ from .tracer import _master_enabled
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
+#: the Prometheus text exposition content type — the single constant the
+#: HTTP front-end's ``/metrics`` response and any scraper agree on
+#: (text format 0.0.4; docs/observability.md)
+CONTENT_TYPE_LATEST = "text/plain; version=0.0.4; charset=utf-8"
+
 
 def _sanitize(name: str) -> str:
     name = _NAME_RE.sub("_", name)
@@ -244,26 +249,36 @@ class Registry:
         return list(zip(names, values))
 
     def exposition(self) -> str:
-        """Render the Prometheus text exposition format. Gauge callbacks
-        and group ``get_name_value()`` run outside the registry lock."""
+        """Render the Prometheus text exposition format (0.0.4 — serve it
+        with ``CONTENT_TYPE_LATEST``). EVERY family gets a ``# HELP`` and
+        a ``# TYPE`` line, emitted once per family (labeled gauge series
+        and group instances share theirs); families without declared help
+        fall back to the family name, so scrapers always see well-formed
+        framing. Gauge callbacks and group ``get_name_value()`` run
+        outside the registry lock."""
         metrics, groups = self._snapshot()
         out: List[str] = []
-        typed = set()  # emit HELP/TYPE once per family (labeled series)
+        headed = set()  # families whose HELP/TYPE already went out
+
+        def _head(name: str, kind: str, help_text: str):
+            if name in headed:
+                return
+            headed.add(name)
+            out.append("# HELP %s %s"
+                       % (name, (help_text or name).replace("\n", " ")))
+            out.append("# TYPE %s %s" % (name, kind))
+
         for m in metrics:
             name = _sanitize(m.name)
-            if m.help and name not in typed:
-                out.append("# HELP %s %s" % (name, m.help.replace("\n", " ")))
             if isinstance(m, Counter):
-                out.append("# TYPE %s counter" % name)
+                _head(name, "counter", m.help)
                 out.append("%s %s" % (name, _fmt(m.value)))
             elif isinstance(m, Gauge):
-                if name not in typed:
-                    out.append("# TYPE %s gauge" % name)
-                    typed.add(name)
+                _head(name, "gauge", m.help)
                 out.append("%s%s %s" % (name, _render_labels(m.labels),
                                         _fmt(m.value)))
             elif isinstance(m, Histogram):
-                out.append("# TYPE %s histogram" % name)
+                _head(name, "histogram", m.help)
                 counts, s, n = m.snapshot()
                 acc = 0
                 for b, c in zip(m.buckets, counts):
@@ -274,9 +289,9 @@ class Registry:
                 out.append("%s_count %d" % (name, n))
         for prefix, sid, obj in groups:
             for n, v in obj.get_name_value():
-                out.append('%s_%s{sid="%d"} %s'
-                           % (_sanitize(prefix), _sanitize(str(n)), sid,
-                              _fmt(v)))
+                fam = "%s_%s" % (_sanitize(prefix), _sanitize(str(n)))
+                _head(fam, "gauge", "")
+                out.append('%s{sid="%d"} %s' % (fam, sid, _fmt(v)))
         return "\n".join(out) + "\n"
 
     def reset(self):

@@ -375,3 +375,10 @@ def test_dec_example():
     centers; Hungarian-matched cluster accuracy."""
     out = _run("examples/dec/dec.py", "--steps", "60")
     assert "dec OK" in out
+
+
+def test_http_serving_example():
+    """HTTP front-end walkthrough: predict round-trip + SSE generate
+    stream against two in-process front-ends."""
+    out = _run("examples/http-serving/serve.py", "--selftest")
+    assert "http-serving selftest PASSED" in out
