@@ -58,7 +58,9 @@ print('sanitizer: 0 reports (serving)')"
 # must run >= 2x the unpaged slot-equivalent co-residency, save >= 50% of
 # prefill tokens via shared blocks, stay bitwise-identical to the unpaged
 # arm, and add zero steady-state compiles — all sanitizer-clean.
-JAX_PLATFORMS=cpu MXNET_ENGINE_SANITIZER=1 python -c "
+# The compile witness rides along (ISSUE 18): the warm paged wave flips
+# witness.steady_state() and must record ZERO fresh compiles after it.
+JAX_PLATFORMS=cpu MXNET_ENGINE_SANITIZER=1 MXNET_COMPILE_WITNESS=1 python -c "
 import __graft_entry__ as g; g.dryrun_decode()
 from mxnet_tpu import engine
 assert engine.sanitizer_reports() == [], engine.sanitizer_reports()
@@ -104,8 +106,10 @@ print('sanitizer: 0 reports (spec)')"
 # must shed FAST with 429s (no queue-and-expire timeouts), a SIGTERM
 # mid-stream drain that drops zero tokens, and a warm restart over the
 # same progcache dir at ZERO fresh compiles with identical greedy
-# streams. MXNET_ENGINE_SANITIZER=1 is inherited by the serve arms.
-JAX_PLATFORMS=cpu MXNET_ENGINE_SANITIZER=1 \
+# streams. MXNET_ENGINE_SANITIZER=1 is inherited by the serve arms, and
+# so is MXNET_COMPILE_WITNESS=1: the warm serve arm flips
+# witness.steady_state() once ready and must report 0 compiles after it.
+JAX_PLATFORMS=cpu MXNET_ENGINE_SANITIZER=1 MXNET_COMPILE_WITNESS=1 \
     python -c "import __graft_entry__ as g; g.dryrun_http()"
 
 echo "== stage 6: import hygiene =="
@@ -117,7 +121,7 @@ assert mx.libinfo.find_lib_path()
 print("import OK; ops:", len(mx.ops.registry.OP_REGISTRY))
 EOF
 
-echo "== stage 7: static analysis (lock-order / engine / purity / progcache-io / racecheck) =="
+echo "== stage 7: static analysis (lock-order / engine / purity / progcache-io / racecheck / compilesurface) =="
 # Pure-AST gate, independent of the pytest tiers: the shipped tree must
 # produce no findings beyond ci/analysis_baseline.json (each baselined
 # entry carries a written justification). Fails on ANY new finding.
@@ -127,7 +131,8 @@ timeout -k 5 15 env JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis --fail-on-new
 # lobotomized analyzer would otherwise pass CI forever).
 for bad in abba_deadlock undeclared_mutable impure_jit telemetry_in_jit \
         capture_unstable raw_write_progcache fuse_ineligible \
-        undeclared_var_access unfenced_host_read var_use_after_delete; do
+        undeclared_var_access unfenced_host_read var_use_after_delete \
+        weight_closure stray_jit donated_arg_reuse undeclared_budget; do
     if JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis \
             --root "tests/fixtures/analysis/${bad}.py" \
             --baseline none --fail-on-new >/dev/null 2>&1; then

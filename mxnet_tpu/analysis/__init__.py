@@ -19,30 +19,40 @@ Three pure-``ast`` checkers (no module under analysis is imported):
                         state with no fence between, engine-var use
                         after ``delete_variable`` — the static half of
                         the ``MXNET_ENGINE_SANITIZER`` pair
+- :mod:`.compilesurface` bounded-program invariant: jit sites outside
+                        the sanctioned surfaces, weights closed over by
+                        traced fns, donated buffers dereferenced after
+                        the call, sanctioned surfaces missing a
+                        :data:`PROGRAM_BUDGETS` bound — the static half
+                        of the ``MXNET_COMPILE_WITNESS`` pair
 
 Run ``python -m mxnet_tpu.analysis --fail-on-new`` (the CI gate) or use
 :func:`run_analysis` programmatically. Findings carry stable fingerprints;
 ``ci/analysis_baseline.json`` allowlists justified ones. The runtime
-complement is :class:`.witness.LockOrderWitness`.
+complements are :class:`.witness.LockOrderWitness` and
+:mod:`.compile_witness`.
 """
 from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence
 
+from . import compile_witness
+from .compilesurface import PROGRAM_BUDGETS, SANCTIONED_SURFACES
 from .core import (Finding, SourceModule, dedupe, diff_against_baseline,
                    load_baseline, load_modules, write_baseline)
 from .lockorder import LOCK_HIERARCHY
 from .witness import LockOrderWitness
 
-CHECKERS = ("lockorder", "engine", "purity", "progcache_io", "racecheck")
+CHECKERS = ("lockorder", "engine", "purity", "progcache_io", "racecheck",
+            "compilesurface")
 
 
 def run_analysis(root: str,
                  checks: Optional[Sequence[str]] = None) -> List[Finding]:
     """Run the selected checkers (default: all) over every ``*.py`` under
     ``root`` and return deduped, location-sorted findings."""
-    from . import (engine_lint, lockorder, progcache_io, racecheck,
-                   trace_purity)
+    from . import (compilesurface, engine_lint, lockorder, progcache_io,
+                   racecheck, trace_purity)
     checks = tuple(checks) if checks else CHECKERS
     modules = load_modules(root)
     findings: List[Finding] = []
@@ -56,9 +66,13 @@ def run_analysis(root: str,
         findings += progcache_io.check(modules)
     if "racecheck" in checks:
         findings += racecheck.check(modules)
+    if "compilesurface" in checks:
+        findings += compilesurface.check(modules)
     return dedupe(findings)
 
 
 __all__ = ["Finding", "SourceModule", "LockOrderWitness", "LOCK_HIERARCHY",
-           "CHECKERS", "run_analysis", "load_modules", "load_baseline",
-           "write_baseline", "diff_against_baseline", "dedupe"]
+           "CHECKERS", "PROGRAM_BUDGETS", "SANCTIONED_SURFACES",
+           "compile_witness", "run_analysis", "load_modules",
+           "load_baseline", "write_baseline", "diff_against_baseline",
+           "dedupe"]

@@ -9,7 +9,7 @@
 Environment defaults (flags win): MXNET_ANALYSIS_MODE (``report`` |
 ``fail-on-new``), MXNET_ANALYSIS_BASELINE (path or ``none``),
 MXNET_ANALYSIS_CHECKS (comma list of
-lockorder,engine,purity,progcache_io,racecheck),
+lockorder,engine,purity,progcache_io,racecheck,compilesurface),
 MXNET_ANALYSIS_ROOT (scan root). See docs/static_analysis.md.
 
 Exit codes: 0 clean (or no NEW findings in fail-on-new mode), 1 findings

@@ -108,6 +108,11 @@ LOCK_HIERARCHY: Dict[str, int] = {
     # and the session stat dict only; executable serialization, entry
     # commits, and telemetry increments happen outside holds of it.
     "progcache._lock": 100,
+    # compile witness record tables: leaf — dict bookkeeping only; the
+    # telemetry counter increments happen after release. May nest under
+    # other leaves (BucketCache._lock builds programs under its hold) —
+    # safe because nothing is ever acquired under THIS lock.
+    "analysis.compile_witness._lock": 100,
     "torch._TH_LOCK": 90,
     "io.DevicePrefetchIter._lock": 100,
     "random._lock": 100,

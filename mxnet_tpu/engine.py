@@ -728,17 +728,20 @@ class FusedSequence:
                       for l in jax.tree_util.tree_leaves(carry0[k])))
             for k in sorted(carry0))
         from . import progcache as _progcache
+        from .analysis import compile_witness as _witness
         need_text = any(f.fingerprint is None for f in fuses)
         key = _progcache.fused_key(
             repr((sigparts, carry_avals)),
             lowered.as_text() if need_text else None)
         self.signature = key
-        exe = _progcache.load(key) if _progcache.enabled() else None
+        exe = (_progcache.load(key, kind="fused")
+               if _progcache.enabled() else None)
         if exe is not None:
             _fuse_stats["disk_loads"] += 1
         else:
             exe = lowered.compile()
             _fuse_stats["compiles"] += 1
+            _witness.record_compile("fused", key=key[:16])
             if _progcache.enabled():
                 _progcache.store(key, exe, note="fused:%s" % name,
                                  kind="fused")

@@ -32,6 +32,7 @@ import numpy as np
 from . import progcache as _progcache
 from . import random as _random
 from . import telemetry as _telemetry
+from .analysis import compile_witness as _witness
 from .base import MXNetError
 from .context import Context, default_context
 from .ndarray import NDArray
@@ -423,6 +424,8 @@ class Executor:
                     # (expensive, scan-of-steps) compile isn't worth it.
                     learned = jf.lower(params, states, aux_values, rng,
                                        dv, *extra).compile()
+                    _witness.record_compile("train_step",
+                                            key="auto_layout")
                     pf, sf = (learned.input_formats[0][0],
                               learned.input_formats[0][1])
                     aot["informats"] = (pf, sf)
@@ -459,9 +462,11 @@ class Executor:
                         key = _progcache.lowered_key(
                             lowered.as_text(), donate=(0, 1),
                             extra="train_step")
-                        exe = _progcache.load(key)
+                        exe = _progcache.load(key, kind="train_step")
                         if exe is None:
                             exe = lowered.compile()
+                            _witness.record_compile("train_step",
+                                                    key=key[:16])
                             _progcache.store(key, exe, note="train_step",
                                              kind="train_step")
                         aot["exec"] = exe

@@ -30,6 +30,7 @@ import numpy as np
 from . import ndarray as nd
 from . import progcache
 from . import symbol as sym_mod
+from .analysis import compile_witness as _witness
 from .base import MXNetError
 from .ndarray import NDArray
 
@@ -44,12 +45,22 @@ _DISK_LOAD_COUNT = 0
 
 
 def compile_count() -> int:
-    """Number of Predictor XLA compilations in this process."""
+    """Number of Predictor XLA compilations in this process. With the
+    compile witness armed (``MXNET_COMPILE_WITNESS=1``) this reads the
+    witness ledger — one accounting source — covering both float and
+    quantized predictors; otherwise the module counter."""
+    if _witness.enabled():
+        return (_witness.compiles_total("predictor")
+                + _witness.compiles_total("quant"))
     return _COMPILE_COUNT
 
 
 def disk_load_count() -> int:
-    """Number of Predictor programs loaded from mxnet_tpu.progcache."""
+    """Number of Predictor programs loaded from mxnet_tpu.progcache
+    (witness ledger when armed, like :func:`compile_count`)."""
+    if _witness.enabled():
+        return (_witness.disk_loads_total("predictor")
+                + _witness.disk_loads_total("quant"))
     return _DISK_LOAD_COUNT
 
 
@@ -145,7 +156,7 @@ class Predictor:
             cache_key = progcache.predictor_key(
                 fp, input_names, self._input_shapes, self._dtype,
                 self._device)
-            loaded = progcache.load(cache_key)
+            loaded = progcache.load(cache_key, kind="predictor")
             if loaded is not None:
                 self._lowered = None
                 self._exec = loaded
@@ -160,6 +171,9 @@ class Predictor:
             self._lowered = self._jitted.lower(*specs)
             self._exec = self._lowered.compile()
         _COMPILE_COUNT += 1
+        _witness.record_compile(
+            "predictor", key=cache_key or "",
+            shapes=repr(sorted(self._input_shapes.items())))
         self.progcache_source = "compile"
         if cache_key is not None:
             progcache.store(cache_key, self._exec, note="predictor",

@@ -431,12 +431,14 @@ def _drop_bad_entry(d: str, key: str):
                 pass
 
 
-def load(key: str):
+def load(key: str, kind: str = ""):
     """The deserialized, loaded executable for ``key``, or None.
 
     None means 'compile fresh' — either a clean miss (counted in
     ``progcache_misses``) or a damaged/skewed entry (counted in
-    ``progcache_fallbacks`` and deleted). Never raises."""
+    ``progcache_fallbacks`` and deleted). Never raises. ``kind`` tags the
+    hit for the compile witness (``analysis.compile_witness``) so disk
+    loads are accounted per surface; empty skips the witness."""
     d = cache_dir()
     if d is None:
         return None
@@ -469,6 +471,10 @@ def load(key: str):
             return None
     touch(key)
     _count("hits")
+    if kind:
+        from .analysis import compile_witness as _witness
+
+        _witness.record_disk_load(kind, key=key)
     return exe
 
 

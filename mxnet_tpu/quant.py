@@ -42,6 +42,7 @@ import numpy as np
 from . import predict as predict_mod
 from . import progcache
 from . import telemetry as _telemetry
+from .analysis import compile_witness as _witness
 from .base import MXNetError
 from .ops.contrib import dequantize_symmetric, quantize_symmetric
 
@@ -284,7 +285,7 @@ class QuantizedPredictor(predict_mod.Predictor):
                     self._lowered.as_text(), donate=(),
                     extra="quant_predictor:%s:%s"
                     % (self._qconfig.weight_dtype, self._qconfig.act_dtype))
-                loaded = progcache.load(cache_key)
+                loaded = progcache.load(cache_key, kind="quant")
                 if loaded is not None:
                     self._exec = loaded
                     self.progcache_source = "disk"
@@ -292,6 +293,9 @@ class QuantizedPredictor(predict_mod.Predictor):
                     return
             self._exec = self._lowered.compile()
         predict_mod._COMPILE_COUNT += 1
+        _witness.record_compile(
+            "quant", key=cache_key or "",
+            shapes=repr(sorted(self._input_shapes.items())))
         self.progcache_source = "compile"
         if cache_key is not None:
             progcache.store(cache_key, self._exec, note="quant_predictor",

@@ -19,6 +19,7 @@ import threading
 from typing import Dict, List, Optional, Sequence
 
 from .. import progcache as _progcache
+from ..analysis import compile_witness as _witness
 from .batcher import ServingError
 
 
@@ -50,6 +51,10 @@ class BucketCache:
         # from a compile storm.
         self.compiles = 0
         self.disk_hits = 0
+        # per-instance compile-witness scope: the inner Predictor compile
+        # (or progcache disk load) a reshape triggers is tagged with it,
+        # so stats() can report the witness ledger's split when armed
+        self._witness_scope = _witness.new_scope()
         # LRU bookkeeping for ladder swaps: logical tick per get(), so
         # set_ladder can retire the programs traffic stopped touching
         self._tick = 0
@@ -91,7 +96,8 @@ class BucketCache:
             self.misses += 1
             shapes = {n: (bucket,) + s
                       for n, s in self._example_shapes.items()}
-            exe = self._base.reshape(shapes, device=self._device)
+            with _witness.surface(self._witness_scope):
+                exe = self._base.reshape(shapes, device=self._device)
             self._count_build(exe)
             self._execs[bucket] = exe
             self._last_used[bucket] = self._tick
@@ -131,7 +137,8 @@ class BucketCache:
             self.misses += 1
             shapes = {n: (bucket,) + s
                       for n, s in self._example_shapes.items()}
-            exe = self._base.reshape(shapes, device=self._device)
+            with _witness.surface(self._witness_scope):
+                exe = self._base.reshape(shapes, device=self._device)
             self._count_build(exe)
             self._execs[bucket] = exe
             self._last_used[bucket] = self._tick
@@ -153,7 +160,8 @@ class BucketCache:
                 return exe
         shapes = {n: (bucket,) + s
                   for n, s in self._example_shapes.items()}
-        exe = self._base.reshape(shapes, device=self._device)
+        with _witness.surface(self._witness_scope):
+            exe = self._base.reshape(shapes, device=self._device)
         with self._lock:
             cur = self._execs.get(bucket)
             if cur is not None:
@@ -265,10 +273,18 @@ class BucketCache:
         """``compiles`` counts FRESH XLA compiles only; ``disk_hits`` are
         misses filled from the persistent progcache; ``cache_hits`` is the
         in-memory hit count (alias of the historical ``hits`` key, kept
-        for compatibility)."""
+        for compatibility). With the compile witness armed the
+        compile/disk split comes from the witness ledger (this cache's
+        scope), so the split and the process-wide counters can never
+        disagree."""
         with self._lock:
-            return {"hits": self.hits, "cache_hits": self.hits,
-                    "misses": self.misses, "compiles": self.compiles,
-                    "disk_hits": self.disk_hits,
-                    "buckets": list(self.buckets),
-                    "compiled": sorted(self._execs)}
+            out = {"hits": self.hits, "cache_hits": self.hits,
+                   "misses": self.misses, "compiles": self.compiles,
+                   "disk_hits": self.disk_hits,
+                   "buckets": list(self.buckets),
+                   "compiled": sorted(self._execs)}
+        if _witness.enabled():
+            sc = _witness.scope_counts(self._witness_scope)
+            out["compiles"] = sc["compiles"]
+            out["disk_hits"] = sc["disk_hits"]
+        return out

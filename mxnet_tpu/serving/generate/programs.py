@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ... import progcache as _progcache
+from ...analysis import compile_witness as _witness
 from ..batcher import ServingError
 from .model import KV_SLAB_DTYPES, DecodeModel
 
@@ -52,29 +53,33 @@ class _Compiled:
         self._jit = jax.jit(fn, donate_argnums=tuple(donate))
         self._exec = None
         self.source = "jit"
-        try:
-            lowered = self._jit.lower(*avals)
-            key = None
-            if _progcache.enabled():
-                key = _progcache.lowered_key(
-                    lowered.as_text(), donate=tuple(donate), extra=note)
-                exe = _progcache.load(key)
-                if exe is not None:
-                    self._exec, self.source = exe, "disk"
-                    counters.disk_hits += 1
-                    return
-            self._exec = lowered.compile()
-            self.source = "compile"
-            counters.compiles += 1
-            if key is not None:
-                _progcache.store(key, self._exec, note=note, kind="decode")
-        except Exception:
-            # anything going sideways in lowering/AOT pins the plain-jit
-            # path; its first call is still one fresh compile
-            log.warning("generate: AOT path failed for %s; using plain jit",
-                        note, exc_info=True)
-            self._exec = None
-            counters.compiles += 1
+        with _witness.surface(counters._witness_scope):
+            try:
+                lowered = self._jit.lower(*avals)
+                key = None
+                if _progcache.enabled():
+                    key = _progcache.lowered_key(
+                        lowered.as_text(), donate=tuple(donate), extra=note)
+                    exe = _progcache.load(key, kind="decode")
+                    if exe is not None:
+                        self._exec, self.source = exe, "disk"
+                        counters.disk_hits += 1
+                        return
+                self._exec = lowered.compile()
+                self.source = "compile"
+                counters.compiles += 1
+                _witness.record_compile("decode", key=note)
+                if key is not None:
+                    _progcache.store(key, self._exec, note=note,
+                                     kind="decode")
+            except Exception:
+                # anything going sideways in lowering/AOT pins the plain-jit
+                # path; its first call is still one fresh compile
+                log.warning("generate: AOT path failed for %s; using plain "
+                            "jit", note, exc_info=True)
+                self._exec = None
+                counters.compiles += 1
+                _witness.record_compile("decode", key=note + ":jit_fallback")
 
     def __call__(self, *args):
         if self._exec is not None:
@@ -126,6 +131,10 @@ class DecodePrograms:
         self.kv_dtype = kv_dtype
         self.compiles = 0    # fresh XLA compiles (the CI-gated bound)
         self.disk_hits = 0   # progcache warm loads
+        # per-instance compile-witness scope: every _Compiled build tags
+        # its fresh compiles / disk loads with it, so the witness ledger
+        # splits per program set (scheduler.stats reads it back)
+        self._witness_scope = _witness.new_scope()
         self._params_avals = _avals(model.params)
         self._step_params_avals = _avals(self.step_model.params)
         self._prefill: Dict[int, _Compiled] = {}
@@ -384,6 +393,7 @@ class PagedDecodePrograms(DecodePrograms):
         self.num_blocks = int(num_blocks)        # usable (excludes trash)
         self.compiles = 0
         self.disk_hits = 0
+        self._witness_scope = _witness.new_scope()
         self._params_avals = _avals(model.params)
         self._step_params_avals = _avals(self.step_model.params)
         self._prefill: Dict[int, _Compiled] = {}

@@ -45,6 +45,7 @@ import numpy as np
 
 from ... import engine as _engine
 from ... import telemetry as _telemetry
+from ...analysis import compile_witness as _witness
 from ..batcher import ServingError
 from .kv_cache import KVCacheManager
 from .model import DecodeModel
@@ -672,8 +673,15 @@ class DecodeScheduler:
         with self._cond:
             queued = len(self._queue)
             active = len(self._active)
-        st = {"compiles": self.programs.compiles,
-              "disk_hits": self.programs.disk_hits,
+        # with the compile witness armed, the compile/disk split is read
+        # back from the witness ledger (this program set's scope) so the
+        # per-set stats and the process-wide counters share one source
+        n_compiles, n_disk = self.programs.compiles, self.programs.disk_hits
+        if _witness.enabled():
+            sc = _witness.scope_counts(self.programs._witness_scope)
+            n_compiles, n_disk = sc["compiles"], sc["disk_hits"]
+        st = {"compiles": n_compiles,
+              "disk_hits": n_disk,
               "steps": self.steps, "queued": queued, "active": active,
               "kv_dtype": self.kv_dtype,
               "quant_weights": self.config.quant_weights or "off",

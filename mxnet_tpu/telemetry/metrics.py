@@ -38,15 +38,24 @@ def _sanitize(name: str) -> str:
 
 
 class Counter:
-    """Monotonic counter (``get_name_value()`` → one pair)."""
+    """Monotonic counter (``get_name_value()`` → one pair).
 
-    __slots__ = ("name", "help", "_value", "_lock")
+    Optional ``labels`` make this one SERIES of a labeled family — the
+    registry keys labeled counters by ``name{k="v"}`` (same contract as
+    labeled gauges; the unlabeled spelling is unchanged)."""
 
-    def __init__(self, name: str, help: str = ""):
+    __slots__ = ("name", "help", "_value", "_lock", "labels")
+
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[Dict[str, str]] = None):
         self.name = name
         self.help = help
         self._value = 0
         self._lock = threading.Lock()
+        self.labels = dict(labels) if labels else None
+
+    def sample_name(self) -> str:
+        return self.name + _render_labels(self.labels)
 
     def inc(self, n=1):
         if not _master_enabled():
@@ -59,7 +68,7 @@ class Counter:
         return self._value
 
     def get_name_value(self):
-        return [(self.name, self._value)]
+        return [(self.sample_name(), self._value)]
 
 
 def _render_labels(labels) -> str:
@@ -185,8 +194,12 @@ class Registry:
                             % (name, type(m).__name__))
         return m
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get_or_create(name, Counter, help)
+    def counter(self, name: str, help: str = "",
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        """Labeled counters are keyed by ``name{k="v"}`` — each label set
+        is its own series (same contract as :meth:`gauge`)."""
+        return self._get_or_create(name, Counter, help, labels,
+                                   key=name + _render_labels(labels))
 
     def gauge(self, name: str, fn: Optional[Callable[[], float]] = None,
               help: str = "",
@@ -272,7 +285,8 @@ class Registry:
             name = _sanitize(m.name)
             if isinstance(m, Counter):
                 _head(name, "counter", m.help)
-                out.append("%s %s" % (name, _fmt(m.value)))
+                out.append("%s%s %s" % (name, _render_labels(m.labels),
+                                        _fmt(m.value)))
             elif isinstance(m, Gauge):
                 _head(name, "gauge", m.help)
                 out.append("%s%s %s" % (name, _render_labels(m.labels),

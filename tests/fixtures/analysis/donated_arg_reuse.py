@@ -1,0 +1,21 @@
+"""Fixture: donated-arg-reuse. Never imported — parsed only.
+
+``bad_step`` passes ``slab`` at a donated position and then reads it
+after the call — the buffer was handed to XLA and may be aliased or
+freed. ``clean_step`` rebinds from the return value and must NOT be
+flagged.
+"""
+import jax
+
+
+def bad_step(step_fn, params, slab, tokens):
+    jitted = jax.jit(step_fn, donate_argnums=(1,))
+    logits, new_slab = jitted(params, slab, tokens)
+    stale = slab.sum()            # use-after-donate
+    return logits, stale
+
+
+def clean_step(step_fn, params, slab, tokens):
+    jitted = jax.jit(step_fn, donate_argnums=(1,))
+    logits, slab = jitted(params, slab, tokens)
+    return logits, slab.sum()     # rebound — the NEW buffer

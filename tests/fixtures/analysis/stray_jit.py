@@ -1,0 +1,17 @@
+"""Fixture: stray-jit. Never imported — parsed only.
+
+``ad_hoc_program`` jits from a random helper outside every sanctioned
+compile surface and with no sanctioned caller — the per-request
+recompile pattern the bounded-program invariant forbids.
+"""
+import jax
+
+
+def ad_hoc_program(fn, xs):
+    jitted = jax.jit(fn)          # stray: not a sanctioned surface
+    return jitted(xs)
+
+
+def handle_request(fn, payload):
+    # calling through a stray helper does not sanction it
+    return ad_hoc_program(fn, payload)

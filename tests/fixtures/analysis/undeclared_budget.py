@@ -1,0 +1,14 @@
+"""Fixture: undeclared-program-budget. Never imported — parsed only.
+
+``DecodePrograms`` matches a sanctioned compile-surface name, but this
+module's surface id (``undeclared_budget.DecodePrograms``) has no entry
+in ``analysis.PROGRAM_BUDGETS`` — a sanctioned surface without a
+registered ladder+k bound must be flagged.
+"""
+import jax
+
+
+class DecodePrograms:
+    def __init__(self, step_fn, avals):
+        self._jit = jax.jit(step_fn, donate_argnums=(1, 2))
+        self._exec = self._jit.lower(*avals).compile()

@@ -1,0 +1,28 @@
+"""Fixture: weight-as-closure-constant. Never imported — parsed only.
+
+``bad_compile`` jits a forward fn that closes over ``param_vals`` and
+the ``weights`` dict instead of passing them as arguments; the checker
+must flag both free names. ``clean_compile`` passes weights as
+arguments and must NOT be flagged.
+"""
+import jax
+
+
+def bad_compile(symbol, param_vals, aux_weights):
+    weights = dict(param_vals)
+
+    def fwd(*inputs):
+        args = dict(weights)          # weight state baked in at trace
+        args.update(dict(zip(symbol.input_names, inputs)))
+        return symbol.eval(args, aux_weights)
+
+    return jax.jit(fwd)
+
+
+def clean_compile(symbol):
+    def fwd(params, aux, *inputs):
+        args = dict(params)
+        args.update(dict(zip(symbol.input_names, inputs)))
+        return symbol.eval(args, aux)
+
+    return jax.jit(fwd)

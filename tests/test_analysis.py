@@ -58,8 +58,11 @@ def test_impure_jit_fixture_flags_all_purity_rules():
                  "callback-shared-state"):
         assert rule in rules, rule
     # clean_step/clean_norm (jax.random with explicit key) are NOT flagged
+    # by the purity checker (compilesurface's stray-jit fires on the bare
+    # jax.jit here, by design — scope the cleanliness claim to purity).
     assert all("clean_step" not in f.qualname
-               and "clean_norm" not in f.qualname for f in fs)
+               and "clean_norm" not in f.qualname
+               for f in fs if f.checker == "purity")
 
 
 def test_telemetry_in_jit_fixture_flags_trace_time_instrumentation():

@@ -1,0 +1,217 @@
+"""mxnet_tpu.analysis.compilesurface + compile_witness — the bounded-
+program invariant, static and dynamic halves (ISSUE 18).
+
+Static: the four checker rules each trip on their known-bad fixture
+(parsed, never imported) and the shipped tree stays clean beyond the
+justified baseline. Dynamic: the runtime witness records every fresh
+Predictor compile, flags any compile after ``steady_state()`` with the
+causing stack, keeps the compile accounting unified (module counters ==
+witness ledger), and is inert when disabled.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis, predict
+from mxnet_tpu.analysis import compile_witness as witness
+from mxnet_tpu.analysis import compilesurface
+from mxnet_tpu.analysis.__main__ import main as cli_main
+from mxnet_tpu.serving.bucket_cache import BucketCache
+from mxnet_tpu.telemetry.metrics import registry
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "fixtures", "analysis")
+
+
+def fixture(name):
+    return os.path.join(FIXTURES, name)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# --- static: the four rules on their fixtures --------------------------------
+
+def test_weight_closure_fixture_flags_both_free_names():
+    fs = analysis.run_analysis(fixture("weight_closure.py"),
+                               checks=("compilesurface",))
+    hits = [f for f in fs if f.rule == "weight-as-closure-constant"]
+    assert {f.subject for f in hits} == {"fwd:weights", "fwd:aux_weights"}
+    # the argument-passing counterpart is never flagged for weight closure
+    assert all("clean_compile" not in f.qualname for f in hits)
+
+
+def test_stray_jit_fixture_flags_unsanctioned_site():
+    fs = analysis.run_analysis(fixture("stray_jit.py"),
+                               checks=("compilesurface",))
+    hits = [f for f in fs if f.rule == "stray-jit"]
+    assert len(hits) == 1
+    assert "ad_hoc_program" in hits[0].qualname
+    # calling through an unsanctioned helper does not sanction it
+    assert "not sanctioned" in hits[0].message
+
+
+def test_donated_arg_reuse_fixture_flags_use_after_donate():
+    fs = analysis.run_analysis(fixture("donated_arg_reuse.py"),
+                               checks=("compilesurface",))
+    hits = [f for f in fs if f.rule == "donated-arg-reuse"]
+    assert len(hits) == 1
+    assert hits[0].subject == "slab"
+    assert "bad_step" in hits[0].qualname
+    # the rebinding counterpart is clean
+    assert all("clean_step" not in f.qualname for f in hits)
+
+
+def test_undeclared_budget_fixture_flags_missing_bound():
+    fs = analysis.run_analysis(fixture("undeclared_budget.py"),
+                               checks=("compilesurface",))
+    hits = [f for f in fs if f.rule == "undeclared-program-budget"]
+    assert len(hits) == 1
+    assert "DecodePrograms" in hits[0].subject
+
+
+# --- static: the tree, the budgets, the CLI gate -----------------------------
+
+def test_shipped_tree_is_clean_beyond_baseline():
+    assert cli_main(["--fail-on-new"]) == 0
+
+
+def test_every_sanctioned_surface_in_tree_declares_a_budget():
+    # every surface pattern that matches a real module must resolve to a
+    # PROGRAM_BUDGETS key; the budgets table itself must only name
+    # sanctioned patterns (no orphan budgets)
+    for key in compilesurface.PROGRAM_BUDGETS:
+        assert any(key.endswith(pat) or ("." + pat + ".") in ("." + key + ".")
+                   for pat in compilesurface.SANCTIONED_SURFACES), key
+    for pat in compilesurface.SANCTIONED_SURFACES:
+        assert any(k.endswith(pat.split(".")[-1]) or pat in k
+                   for k in compilesurface.PROGRAM_BUDGETS), pat
+
+
+def test_cli_trips_on_each_bad_fixture():
+    for bad in ("weight_closure.py", "stray_jit.py",
+                "donated_arg_reuse.py", "undeclared_budget.py"):
+        assert cli_main(["--root", fixture(bad), "--baseline", "none",
+                         "--fail-on-new"]) == 1, bad
+
+
+# --- dynamic: the witness round trip -----------------------------------------
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    shapes, _, _ = sym.infer_shape(data=(1, 10))
+    params = {n: rng.uniform(-0.1, 0.1, s).astype(np.float32)
+              for n, s in zip(sym.list_arguments(), shapes)
+              if n not in ("data", "softmax_label")}
+    return sym, params
+
+
+@pytest.fixture
+def armed_witness():
+    prev = witness.enable(True)
+    witness.reset()
+    yield witness
+    witness.reset()
+    witness.enable(prev)
+
+
+def test_witness_records_compile_and_flags_post_steady_recompile(
+        armed_witness):
+    sym, params = _mlp()
+    p = predict.Predictor(sym.tojson(), params, {"data": (1, 10)})
+    assert witness.compiles_total("predictor") == 1
+    assert witness.compiles_after_steady_total() == 0
+    assert not witness.violations()
+
+    witness.steady_state()
+    assert witness.in_steady_state()
+    # a reshape to an unseen shape compiles fresh — past the marker that
+    # is THE violation the witness exists to catch
+    p.reshape({"data": (4, 10)})
+    assert witness.compiles_after_steady_total() == 1
+    viol = witness.violations()
+    assert len(viol) == 1
+    assert viol[0]["kind"] == "predictor"
+    assert viol[0]["after_steady"] is True
+    # the stack names the compile surface that fired
+    assert any("_compile" in fr for fr in viol[0]["stack"]), viol[0]["stack"]
+
+    rep = witness.compile_witness_report()
+    assert rep["enabled"] and rep["steady"]
+    assert rep["compiles"]["predictor"] == 2
+    assert rep["compiles_after_steady_total"] == 1
+    assert len(rep["violations"]) == 1
+
+
+def test_witness_exports_telemetry_counters(armed_witness):
+    witness.record_compile("decode", key="k")
+    witness.steady_state()
+    witness.record_compile("decode", key="k2")
+    exp = registry.exposition()
+    assert 'compiles_total{kind="decode"}' in exp
+    assert "compiles_after_steady_total" in exp
+
+
+def test_witness_disabled_is_inert():
+    prev = witness.enable(False)
+    witness.reset()
+    try:
+        base = witness.compiles_total()
+        witness.record_compile("decode", key="x")
+        witness.record_disk_load("decode", key="x")
+        witness.steady_state()
+        witness.record_compile("decode", key="y")
+        assert witness.compiles_total() == base == 0
+        assert witness.compiles_after_steady_total() == 0
+        assert not witness.in_steady_state()
+        assert witness.violations() == []
+        # the surface context is the shared no-op singleton when disabled
+        s1 = witness.surface(1)
+        s2 = witness.surface(2)
+        assert s1 is s2
+        with s1:
+            pass
+    finally:
+        witness.reset()
+        witness.enable(prev)
+
+
+# --- dynamic: unified accounting ---------------------------------------------
+
+def test_compile_count_reads_witness_ledger_when_armed(armed_witness):
+    sym, params = _mlp()
+    predict.Predictor(sym.tojson(), params, {"data": (1, 10)})
+    assert predict.compile_count() == witness.compiles_total("predictor") == 1
+    assert predict.disk_load_count() == 0
+
+
+def test_bucket_cache_stats_read_witness_scope(armed_witness):
+    sym, params = _mlp()
+    base = predict.Predictor(sym.tojson(), params, {"data": (1, 10)})
+    cache = BucketCache(base, buckets=(1, 2, 4))
+    cache.get(2)
+    cache.get(4)
+    cache.get(2)     # in-memory hit, not a build
+    st = cache.stats()
+    assert st["compiles"] == 2 and st["disk_hits"] == 0
+    # the scope split and the process-wide ledger agree: base compile
+    # (outside the cache scope) + the two bucket builds
+    assert witness.compiles_total("predictor") == 3
+    assert witness.scope_counts(cache._witness_scope) == \
+        {"compiles": 2, "disk_hits": 0}
+
+
+def test_fixtures_are_never_imported():
+    import sys
+
+    for mod in ("weight_closure", "stray_jit", "donated_arg_reuse",
+                "undeclared_budget"):
+        assert mod not in sys.modules
