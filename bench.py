@@ -426,14 +426,18 @@ def _serving_model():
     return sym, params, in_dim, hidden, classes
 
 
-def _serving_burst(srv, in_dim, n_requests, n_threads, mix):
+def _serving_burst(srv, in_dim, n_requests, n_threads, mix, trace=False):
     """One timed burst of the FIXED request-size mix against a running
     server: every thread walks the same deterministic rows pattern, so
-    the A and B arms see identical traffic."""
+    the A and B arms see identical traffic. ``trace=True`` mints a
+    request-scoped trace context per request (the HTTP edge's behavior),
+    so every span is stamped and teed into the flight recorder — the
+    fully-traced cost arm."""
     import threading
 
     import numpy as np
     from mxnet_tpu import serving
+    from mxnet_tpu.telemetry import context as tctx
 
     errors = []
     per_thread = max(1, n_requests // n_threads)
@@ -444,7 +448,11 @@ def _serving_burst(srv, in_dim, n_requests, n_threads, mix):
             rows = mix[(i + k) % len(mix)]
             x = r.uniform(-1, 1, (rows, in_dim)).astype(np.float32)
             try:
-                srv.predict(data=x)
+                if trace:
+                    with tctx.use(tctx.mint()):
+                        srv.predict(data=x)
+                else:
+                    srv.predict(data=x)
             except serving.ServingError as e:
                 errors.append(e.code)
 
@@ -538,8 +546,15 @@ def run_serving_config():
         # serving+engine spans recording
         telemetry.enable_spans("serving,engine")
         b_on = _serving_burst(srv_b, in_dim, n_requests, n_threads, mix)
+        # fully-traced arm: spans on AND a per-request trace context, so
+        # every span is stamped + teed into the flight recorder — the
+        # cost of the whole ISSUE 19 pipeline under load
+        b_trace = _serving_burst(srv_b, in_dim, n_requests, n_threads,
+                                 mix, trace=True)
         telemetry.disable_spans()
         telemetry.reset()
+        from mxnet_tpu.telemetry import flight as _flight
+        _flight.reset()
         # compile-witness overhead rides along too: off/on bursts
         # INTERLEAVED per repeat and the overhead taken as the median of
         # the paired ratios (the checkpoint bench's drift-immune idiom —
@@ -614,6 +629,10 @@ def run_serving_config():
         "spans_on_qps": round(b_on["_qps"], 1),
         "spans_on_overhead_pct": round(
             100.0 * (b["_qps"] - b_on["_qps"]) / b["_qps"], 2)
+            if b["_qps"] else None,
+        "trace_on_qps": round(b_trace["_qps"], 1),
+        "trace_on_overhead_pct": round(
+            100.0 * (b["_qps"] - b_trace["_qps"]) / b["_qps"], 2)
             if b["_qps"] else None,
     }
     total = cache_b["hits"] + cache_b["misses"]

@@ -111,6 +111,14 @@ print('sanitizer: 0 reports (spec)')"
 # witness.steady_state() once ready and must report 0 compiles after it.
 JAX_PLATFORMS=cpu MXNET_ENGINE_SANITIZER=1 MXNET_COMPILE_WITNESS=1 \
     python -c "import __graft_entry__ as g; g.dryrun_http()"
+# Tracing + flight-recorder gate (ISSUE 19): traced traffic must leave
+# assembled span trees addressable by request id with the same trace_id
+# surfacing as an OpenMetrics exemplar on the latency histogram; one
+# forced deadline miss must write EXACTLY one diagnostic bundle carrying
+# the victim's queued span and bump
+# flight_bundles_total{trigger="deadline_miss"}.
+JAX_PLATFORMS=cpu MXNET_ENGINE_SANITIZER=1 \
+    python -c "import __graft_entry__ as g; g.dryrun_flight()"
 
 echo "== stage 6: import hygiene =="
 python - <<'EOF'

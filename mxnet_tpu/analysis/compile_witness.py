@@ -149,6 +149,14 @@ def _export(kind: str, steady: bool):
             "compiles_after_steady_total",
             help="fresh XLA compiles after witness.steady_state() — any "
                  "nonzero value is a recompile-storm violation").inc()
+        # a steady-state recompile is exactly the anomaly the flight
+        # recorder exists for: snapshot the serving picture around it
+        try:
+            from ..telemetry import flight
+
+            flight.on_anomaly("compile_after_steady", kind=kind)
+        except Exception:
+            pass
 
 
 def record_compile(kind: str, key: str = "", shapes: str = "",

@@ -194,6 +194,18 @@ class _TracedFnCheck:
                 "stops firing once the trace is cached; keep "
                 "instrumentation outside jit/shard_map" % d)
             return
+        if len(parts) == 1 and "telemetry" in root.split("."):
+            # bare from-import (`from ..telemetry.context import
+            # current_context`): the call reads a THREAD-LOCAL at trace
+            # time — the cached trace bakes in whichever request traced
+            # first, cross-wiring every later request's ids
+            self._emit(
+                "telemetry-in-jit", call.lineno, d,
+                "%s (from %s) in a traced fn runs at trace time only — "
+                "a trace-context read is baked into the cached trace as "
+                "a constant; resolve the context outside jit/shard_map "
+                "and pass values in" % (d, root))
+            return
         if len(parts) >= 3 and parts[-2] == "random" and \
                 self.aliases.get(parts[0], parts[0]) == "numpy":
             self._emit("impure-random", call.lineno, d,

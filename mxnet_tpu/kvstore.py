@@ -37,6 +37,7 @@ import numpy as np
 
 from . import ndarray as nd
 from . import telemetry
+from .telemetry import context as _trace_context
 from .base import MXNetError
 from .ndarray import NDArray
 
@@ -333,17 +334,26 @@ class PSKVStore(KVStore):
 
     def init(self, key, value):
         keys, values = _key_value(key, value)
+        ctx = _trace_context.current_context()
         for k, v in zip(keys, values):
             arr = v.asnumpy()
             self._engine.get().push(
-                lambda k=k, arr=arr: self._safe_rpc(
-                    lambda: self._client.init(k, arr)),
+                lambda k=k, arr=arr, c=ctx: self._safe_rpc(
+                    lambda: self._client.init(k, arr), c),
                 mutable_vars=[self._key_var(k)], name="ps_init")
         self.barrier()
 
-    def _safe_rpc(self, fn):
+    def _safe_rpc(self, fn, ctx=None):
+        """Run an RPC thunk on the engine worker thread; when the
+        submitting thread carried a trace context the caller passes it
+        here, so the PSClient serializes it as a traceparent header on
+        the wire even though the RPC runs threads away."""
         try:
-            fn()
+            if ctx is not None:
+                with _trace_context.use(ctx):
+                    fn()
+            else:
+                fn()
         except BaseException as e:  # surface at the next sync point
             self._record_err(e)
 
@@ -355,8 +365,10 @@ class PSKVStore(KVStore):
 
         keys, grouped = _group_kv(key, value)
         nbytes = 0
+        ctx = _trace_context.current_context()
         with telemetry.span("kvstore.push", domain="kvstore",
-                            n_keys=len(keys), ps=True):
+                            n_keys=len(keys), ps=True,
+                            **(ctx.stamps() if ctx is not None else {})):
             for k, vals in zip(keys, grouped):
                 merged = _reduce(vals)  # local device reduce before the wire
                 nbytes += merged._data.nbytes
@@ -366,8 +378,8 @@ class PSKVStore(KVStore):
                 # readback still overlaps training inside the engine op
                 m = NDArray(jnp.copy(merged._data))
                 self._engine.get().push(
-                    lambda k=k, m=m: self._safe_rpc(
-                        lambda: self._client.push(k, m.asnumpy())),
+                    lambda k=k, m=m, c=ctx: self._safe_rpc(
+                        lambda: self._client.push(k, m.asnumpy()), c),
                     mutable_vars=[self._key_var(k)], priority=priority,
                     name="ps_push")
         _push_total.inc(len(keys))
@@ -375,14 +387,17 @@ class PSKVStore(KVStore):
 
     def pull(self, key, out=None, priority=0):
         keys, grouped = _group_kv(key, out)
+        ctx = _trace_context.current_context()
         with telemetry.span("kvstore.pull", domain="kvstore",
-                            n_keys=len(keys), ps=True):
+                            n_keys=len(keys), ps=True,
+                            **(ctx.stamps() if ctx is not None else {})):
             self._pull_impl(keys, grouped, priority)
         _pull_total.inc(len(keys))
         _pull_bytes.inc(sum(o._data.nbytes
                             for outs in grouped for o in outs))
 
     def _pull_impl(self, keys, grouped, priority):
+        ctx = _trace_context.current_context()
         for k, outs in zip(keys, grouped):
             ref_shape = tuple(outs[0].shape)
 
@@ -398,9 +413,10 @@ class PSKVStore(KVStore):
                                              o._data.sharding)
 
             # engine-ordered after every outstanding push of this key
-            self._engine.get().push(lambda f=do_pull: self._safe_rpc(f),
-                                    mutable_vars=[self._key_var(k)],
-                                    priority=priority, name="ps_pull")
+            self._engine.get().push(
+                lambda f=do_pull, c=ctx: self._safe_rpc(f, c),
+                mutable_vars=[self._key_var(k)],
+                priority=priority, name="ps_pull")
         # one pushed barrier over every pulled key: unlike a per-key
         # wait_for_var loop it is a single engine op and orders after the
         # RPCs' host-side completion as well

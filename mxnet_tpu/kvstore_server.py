@@ -52,11 +52,28 @@ import numpy as np
 from multiprocessing.connection import Client, Listener
 
 from .base import MXNetError
+from . import telemetry
+from .telemetry import context as _trace_context
 
 _AUTH = b"mxnet_tpu_ps"
 # header marker for a tensor slot: replaced by (marker, dtype, shape) in
 # the pickled control header; the raw bytes follow as separate frames
 _ND = "__ndarray_frame__"
+
+
+def _trace_header() -> Optional[str]:
+    """Outgoing W3C traceparent when the calling thread carries a trace
+    context, else None — the PS plane's trace-carry header. Spans-off
+    cost on every RPC: one thread-local read."""
+    ctx = _trace_context.current_context()
+    return None if ctx is None else _trace_context.to_traceparent(ctx)
+
+
+def _traced(req: tuple) -> tuple:
+    """Wrap a client request as ``("__traced__", traceparent, *req)``
+    when a trace context is live; pass through untouched otherwise."""
+    tp = _trace_header()
+    return req if tp is None else ("__traced__", tp) + tuple(req)
 
 
 def send_msg(conn, *parts):
@@ -188,6 +205,20 @@ class KVStoreServer:
 
     def _handle(self, conn, req):
         op = req[0]
+        if op == "__traced__":
+            # trace carry from PSClient: ("__traced__", traceparent,
+            # *inner). The server-side span is a CHILD of the worker's
+            # calling span (parse mints a fresh span_id parented on the
+            # header's), so a request's tree shows its PS hops once the
+            # per-process ring files are merged (profiler.dump_profile).
+            tp, inner = req[1], req[2:]
+            if telemetry.enabled("kvstore"):
+                ctx = _trace_context.parse_traceparent(tp)
+                if ctx is not None:
+                    with telemetry.span("kvstore.%s" % (inner[0],),
+                                        domain="kvstore", **ctx.stamps()):
+                        return self._handle(conn, inner)
+            return self._handle(conn, inner)
         if op in ("push", "pull"):  # MXNET_FAULT_PLAN: delayed replies
             from .resilience import faults
 
@@ -353,6 +384,9 @@ class KVStoreServer:
             except (EOFError, OSError):
                 pass
         listener.close()
+        # flush this process's span ring for the worker-side merge
+        # (profiler.dump_profile); no-op unless MXNET_TELEMETRY_RING_DIR
+        telemetry.dump_ring()
 
     def start_background(self):
         """Run in a daemon thread (in-process servers for tests/notebooks)."""
@@ -469,7 +503,7 @@ class PSClient:
         with self._locks[sid]:
             self._inject("ps_%s" % req[0], sid)
             conn = self._ensure_conn(sid)
-            send_msg(conn, *req)
+            send_msg(conn, *_traced(req))
             resp = recv_msg(conn)
         return self._check(resp)
 
@@ -479,11 +513,14 @@ class PSClient:
         also what lets sync-mode pushes of different parts merge
         concurrently server-side. reqs: [(sid, req tuple)], one per sid."""
         sids = [sid for sid, _ in reqs]
+        tp = _trace_header()  # one header for every shard of this call
         for sid in sorted(sids):
             self._locks[sid].acquire()
         try:
             conns = {sid: self._ensure_conn(sid) for sid in sids}
             for sid, req in reqs:
+                if tp is not None:
+                    req = ("__traced__", tp) + tuple(req)
                 send_msg(conns[sid], *req)
             resps = [recv_msg(conns[sid]) for sid, _ in reqs]
         finally:

@@ -90,6 +90,34 @@ def profiler_set_state(state="stop"):
         _state["running"] = False
 
 
+def _ring_file_events(dirs):
+    """Merge span ring files dumped by OTHER processes
+    (``telemetry.dump_ring()`` — PS servers, launcher-spawned workers
+    write ``telemetry_ring_<pid>.json``). Each file's events are already
+    chrome-format; pid tags keep their rows separate in the viewer, and
+    trace-stamped spans join the same trace_id across processes. Files
+    are consumed (removed) so a second dump only sees newer rings."""
+    events = []
+    seen = set()
+    for d in dirs:
+        if not d or d in seen:
+            continue
+        seen.add(d)
+        for path in sorted(glob.glob(
+                os.path.join(d, "telemetry_ring_*.json"))):
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+                evs = (data if isinstance(data, list)
+                       else data.get("traceEvents", [])
+                       if isinstance(data, dict) else [])
+                events.extend(e for e in evs if isinstance(e, dict))
+                os.remove(path)
+            except (OSError, ValueError):
+                continue
+    return events
+
+
 def _jax_trace_events(trace_dir: str):
     """Best-effort: pull traceEvents out of the jax/XLA trace artifacts
     (``*.trace.json.gz`` under the TensorBoard plugin layout) so device
@@ -134,6 +162,8 @@ def dump_profile() -> str:
         except Exception:
             pass
         _state["engine_prof"] = False
+    events.extend(_ring_file_events(
+        [_state["dir"], os.environ.get("MXNET_TELEMETRY_RING_DIR")]))
     events.extend(_jax_trace_events((_state["dir"] or ".") + "/jax_trace"))
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "w") as f:

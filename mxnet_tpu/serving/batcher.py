@@ -36,6 +36,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..base import MXNetError
+from .. import telemetry
+from ..telemetry import flight as _flight
 
 
 class ServingError(MXNetError):
@@ -60,17 +62,22 @@ class Request:
     batch axis (usually 1 row; small batches ride whole — the former never
     splits a request across micro-batches). ``priority`` is the QoS class
     (``PRIORITY_INTERACTIVE``/``PRIORITY_BATCH``); ``request_id`` is an
-    opaque caller correlation id echoed by the HTTP front-end."""
+    opaque caller correlation id echoed by the HTTP front-end;
+    ``trace`` is the request's propagated ``telemetry.TraceContext``
+    (or None) — the object carry that survives the HTTP-thread →
+    former-thread → engine-worker hops."""
 
     __slots__ = ("inputs", "rows", "deadline", "submitted", "latency_ms",
-                 "priority", "request_id", "_event", "_outputs", "_error")
+                 "priority", "request_id", "trace", "_event", "_outputs",
+                 "_error")
 
     def __init__(self, inputs: Dict[str, np.ndarray], rows: int,
                  deadline: Optional[float], priority: int = 0,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None, trace=None):
         self.inputs = inputs
         self.rows = rows
         self.deadline = deadline          # time.monotonic() absolute, or None
+        self.trace = trace
         if not 0 <= int(priority) < _N_PRIORITIES:
             raise ServingError("priority must be 0 (interactive) or 1 "
                                "(batch), got %r" % (priority,))
@@ -172,6 +179,23 @@ class BatchFormer:
         req.set_error(err)
         if self._error_hook is not None:
             self._error_hook(err.code)
+        # observability tail (callers invoke _fail OUTSIDE _cond): a
+        # failed request still gets a serving.queued span so its flight
+        # timeline is complete, and a missed deadline snapshots a
+        # diagnostic bundle — the SLO anomaly this queue exists to avoid
+        if req.trace is not None and telemetry.enabled("serving"):
+            telemetry.complete("serving.queued", domain="serving",
+                               start_ns=int(req.submitted * 1e9),
+                               rows=req.rows, error=err.code,
+                               **req.trace.child().stamps())
+        _flight.request_end(req.trace, ok=False, code=err.code,
+                            latency_ms=req.latency_ms,
+                            request_id=req.request_id)
+        if err.code == "deadline_exceeded":
+            _flight.on_anomaly("deadline_miss", req.trace,
+                               request_id=req.request_id,
+                               latency_ms=req.latency_ms,
+                               message=str(err))
 
     def note_dispatch(self, seconds: float):
         """Feed one observed dispatch service time (seconds from batch

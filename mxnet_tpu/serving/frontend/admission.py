@@ -40,6 +40,8 @@ import weakref
 from typing import Optional, Tuple
 
 from ... import telemetry
+from ...telemetry import context as _trace_context
+from ...telemetry import flight as _flight
 from ..batcher import PRIORITY_BATCH
 
 
@@ -106,7 +108,12 @@ class AdmissionController:
     # --- drain flag -------------------------------------------------------
     def set_draining(self):
         with self._lock:
+            already = self._draining
             self._draining = True
+        if not already:
+            # drain start is an SLO anomaly worth a bundle: it captures
+            # the in-flight picture a rolling restart interrupts
+            _flight.on_anomaly("drain", inflight=self.inflight())
 
     def draining(self) -> bool:
         with self._lock:
@@ -179,4 +186,12 @@ class AdmissionController:
             self._m_shed.inc()
             if decision.retry_after_s == 0:
                 decision.retry_after_s = self._retry_after_s()
+            if decision.code == "shed":
+                # bundle the moment load shedding kicks in (bounded by
+                # MXNET_FLIGHT_MAX_BUNDLES, so a sustained storm writes
+                # a handful, not one per rejected request)
+                _flight.on_anomaly(
+                    "shed", _trace_context.current_context(),
+                    message=decision.message,
+                    retry_after_s=decision.retry_after_s)
         return decision, n

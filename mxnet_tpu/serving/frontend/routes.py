@@ -71,9 +71,16 @@ def status_for_error(code: str, submit_time: bool) -> int:
     return table.get(code, 400 if submit_time else 500)
 
 
-def error_body(code: str, message: str, request_id: str) -> dict:
-    return {"error": {"code": code, "message": message},
+def error_body(code: str, message: str, request_id: str,
+               trace_id: Optional[str] = None) -> dict:
+    """Structured error payload. When the request arrived with (or was
+    assigned) a trace context, the trace id is echoed so the caller can
+    pull the request's span tree from ``GET /debug/requests/<id>``."""
+    body = {"error": {"code": code, "message": message},
             "request_id": request_id}
+    if trace_id:
+        body["trace_id"] = trace_id
+    return body
 
 
 class BadRequest(Exception):

@@ -34,12 +34,13 @@ import os
 import signal
 import threading
 import time
-import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ... import telemetry
+from ...telemetry import context as trace_context
+from ...telemetry import flight as _flight
 from ..batcher import ServingError
 from . import routes
 from .admission import AdmissionController
@@ -93,7 +94,8 @@ class _Handler(BaseHTTPRequestHandler):
         return self.server.frontend
 
     def _request_id(self) -> str:
-        return self.headers.get("x-request-id") or uuid.uuid4().hex[:16]
+        return self.headers.get("x-request-id") or \
+            trace_context.mint_request_id()
 
     def _send_json(self, status: int, payload: dict, request_id: str,
                    retry_after_s: Optional[int] = None):
@@ -102,6 +104,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.send_header("x-request-id", request_id)
+        ctx = getattr(self, "_trace", None)
+        if ctx is not None:
+            self.send_header("x-trace-id", ctx.trace_id)
+            self.send_header("traceparent",
+                             trace_context.to_traceparent(ctx))
         if retry_after_s is not None:
             self.send_header("Retry-After", str(int(retry_after_s)))
         self.end_headers()
@@ -112,8 +119,12 @@ class _Handler(BaseHTTPRequestHandler):
                          retry_after_s: Optional[int] = None):
         if retry_after_s is None and code in routes.RETRYABLE_CODES:
             retry_after_s = 1
+        ctx = getattr(self, "_trace", None)
         self._send_json(status,
-                        routes.error_body(code, message, request_id),
+                        routes.error_body(
+                            code, message, request_id,
+                            trace_id=(ctx.trace_id if ctx is not None
+                                      else None)),
                         request_id, retry_after_s)
 
     def _read_body(self) -> bytes:
@@ -125,6 +136,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     # --- GET --------------------------------------------------------------
     def do_GET(self):
+        self._trace = None  # keep-alive: don't leak a prior POST's trace
         rid = self._request_id()
         if self.path == "/healthz":
             self._send_json(200, {"status": "ok"}, rid)
@@ -143,21 +155,42 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path.startswith("/debug/requests/"):
+            # one request's assembled span tree, by request_id or
+            # trace_id — the landing page of an exemplar / error echo
+            ident = self.path[len("/debug/requests/"):]
+            tree = _flight.request_tree(ident) if ident else None
+            if tree is None:
+                self._send_error_json(404, "not_found",
+                                      "no recorded request %r" % ident,
+                                      rid)
+            else:
+                self._send_json(200, tree, rid)
+        elif self.path == "/debug/flight":
+            self._send_json(200, _flight.summary(), rid)
         else:
             self._send_error_json(404, "not_found",
                                   "no route %r" % self.path, rid)
 
     # --- POST -------------------------------------------------------------
     def do_POST(self):
-        rid = self._request_id()
+        # trace context is minted (or continued from a W3C traceparent
+        # header) at the network edge, installed on this handler thread,
+        # and rides the Request/TokenStream through batcher + scheduler —
+        # every span below stamps the same trace_id (docs/observability.md
+        # "Request tracing")
+        ctx = trace_context.from_headers(self.headers)
+        self._trace = ctx
+        rid = ctx.request_id
         if self.path not in ("/v1/predict", "/v1/generate"):
             self._send_error_json(404, "not_found",
                                   "no route %r" % self.path, rid)
             return
         raw = self._read_body()
         route = self.path.rsplit("/", 1)[-1]
-        with telemetry.span("serving.http.request", domain="serving",
-                            route=route, request_id=rid) as sp:
+        with trace_context.use(ctx), \
+                telemetry.span("serving.http.request", domain="serving",
+                               route=route, **ctx.stamps()) as sp:
             try:
                 body = routes.parse_json_body(raw)
                 priority = routes.parse_priority(
@@ -251,6 +284,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", SSE_CONTENT_TYPE)
         self.send_header("Cache-Control", "no-cache")
         self.send_header("x-request-id", rid)
+        ctx = getattr(self, "_trace", None)
+        if ctx is not None:
+            self.send_header("x-trace-id", ctx.trace_id)
+            self.send_header("traceparent",
+                             trace_context.to_traceparent(ctx))
         self.send_header("Connection", "close")
         self.end_headers()
         self.close_connection = True
@@ -264,9 +302,11 @@ class _Handler(BaseHTTPRequestHandler):
                     n += 1
             except ServingError as e:
                 sp.annotate(tokens=n, error=e.code)
-                self.wfile.write(sse_event(
-                    "error", {"code": e.code, "message": str(e),
-                              "request_id": rid}))
+                evt = {"code": e.code, "message": str(e),
+                       "request_id": rid}
+                if ctx is not None:
+                    evt["trace_id"] = ctx.trace_id
+                self.wfile.write(sse_event("error", evt))
                 self.wfile.flush()
                 return
             sp.annotate(tokens=n, finish_reason=stream.finish_reason)

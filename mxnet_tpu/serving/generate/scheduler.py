@@ -45,6 +45,9 @@ import numpy as np
 
 from ... import engine as _engine
 from ... import telemetry as _telemetry
+from ...telemetry import context as _trace_context
+from ...telemetry import flight as _flight
+from ..metrics import latency_histogram as _latency_histogram
 from ...analysis import compile_witness as _witness
 from ..batcher import ServingError
 from .kv_cache import KVCacheManager
@@ -338,7 +341,8 @@ class DecodeScheduler:
                timeout_ms: Optional[float] = None,
                temperature: float = 0.0,
                seed: Optional[int] = None,
-               request_id: Optional[str] = None) -> TokenStream:
+               request_id: Optional[str] = None,
+               trace=None) -> TokenStream:
         """Queue one prompt. ``temperature`` 0 (default) is greedy —
         bitwise the historical behavior; > 0 samples from the softmax
         with a per-stream RandomState seeded by ``seed`` (deterministic
@@ -363,7 +367,9 @@ class DecodeScheduler:
         deadline = None if timeout_ms is None \
             else time.monotonic() + timeout_ms / 1000.0
         stream = TokenStream(len(prompt), max_new, deadline,
-                             request_id=request_id)
+                             request_id=request_id,
+                             trace=(trace if trace is not None else
+                                    _trace_context.current_context()))
         temperature = float(temperature)
         rng = np.random.RandomState(seed) if temperature > 0.0 else None
         with self._cond:
@@ -423,9 +429,12 @@ class DecodeScheduler:
             self._queue = keep
         for s in cancelled:
             s._finish("cancelled")
+            self._stream_end(s, ok=True, code="cancelled")
         for s in expired:
             s._fail(ServingError("expired before a decode slot freed",
                                  code="deadline_exceeded"))
+            self._stream_end(s, ok=False, code="deadline_exceeded",
+                             queued=True)
         # active sequences: retire cancelled/expired before the next step
         for key, a in list(self._active.items()):
             if a.stream.cancelled:
@@ -435,6 +444,32 @@ class DecodeScheduler:
                     "deadline exceeded mid-stream",
                     code="deadline_exceeded"))
 
+    def _stream_end(self, stream: TokenStream, ok: bool,
+                    code: Optional[str] = None, queued: bool = False):
+        """Observability tail for one finished stream: the registry
+        latency histogram (trace-id exemplar), the flight recorder's
+        completed-request ring, and the deadline-miss bundle trigger.
+        Called with no scheduler locks held."""
+        lat_ms = (time.monotonic() - stream.submitted) * 1e3
+        tr = stream.trace
+        if (queued and tr is not None
+                and _telemetry.enabled("serving")):
+            # a stream that died waiting never got its queued span —
+            # stamp one now so its flight timeline is complete
+            _telemetry.complete("serving.queued", domain="serving",
+                                start_ns=int(stream.submitted * 1e9),
+                                tokens=stream.prompt_len, error=code,
+                                **tr.child().stamps())
+        if ok:
+            _latency_histogram().observe(
+                lat_ms, exemplar=tr.trace_id if tr is not None else None)
+        _flight.request_end(tr, ok=ok, code=code, latency_ms=lat_ms,
+                            kind="generate", request_id=stream.request_id)
+        if code == "deadline_exceeded":
+            _flight.on_anomaly("deadline_miss", tr,
+                               request_id=stream.request_id,
+                               latency_ms=lat_ms, kind="generate")
+
     def _retire(self, a: _Active, reason: Optional[str] = None,
                 error: Optional[ServingError] = None):
         self.caches[a.replica].free(a.slot)
@@ -442,8 +477,10 @@ class DecodeScheduler:
             self._active.pop((a.replica, a.slot), None)
         if error is not None:
             a.stream._fail(error)
+            self._stream_end(a.stream, ok=False, code=error.code)
         else:
             a.stream._finish(reason or "eos")
+            self._stream_end(a.stream, ok=True, code=reason or "eos")
 
     def _pick_replica(self) -> Optional[int]:
         best, best_free = None, 0
@@ -484,14 +521,27 @@ class DecodeScheduler:
             if plan.ctx_len:
                 self._m_prefix_hits.inc()
                 self._m_prefix_saved.inc(plan.ctx_len)
+            # trace plumbing: the queued span closes at admission; the
+            # serving.dispatch span brackets push -> first token (stamped
+            # post-fence); the prefill span nests under it via ts
+            tr = stream.trace
+            dctx, ts = None, None
+            if tr is not None and _telemetry.enabled("serving"):
+                _telemetry.complete("serving.queued", domain="serving",
+                                    start_ns=int(stream.submitted * 1e9),
+                                    tokens=len(prompt),
+                                    **tr.child().stamps())
+                dctx = tr.child()
+                ts = dctx.child().stamps()
             holder: Dict[str, object] = {}
             admitted.append((_Active(stream, rep, plan.slot, 0, 0,
-                                     temperature=temp, rng=rng), holder))
+                                     temperature=temp, rng=rng), holder,
+                             dctx, _telemetry.clock_ns()))
             touched.append(cache.var)
 
             if self.config.paged:
                 def op(cache=cache, plan=plan, holder=holder,
-                       rid=stream.request_id):
+                       rid=stream.request_id, ts=ts):
                     def run():
                         out = self.programs.paged_prefill(
                             cache.k_slab, cache.v_slab, plan.table,
@@ -506,7 +556,9 @@ class DecodeScheduler:
                         with _telemetry.span(
                                 "decode.prefill", domain="serving",
                                 tokens=len(plan.suffix),
-                                reused=plan.ctx_len, request_id=rid):
+                                reused=plan.ctx_len,
+                                **(ts if ts is not None
+                                   else {"request_id": rid})):
                             if plan.forked:
                                 with _telemetry.span(
                                         "decode.cow_fork", domain="serving",
@@ -519,12 +571,13 @@ class DecodeScheduler:
                         holder["error"] = e
             else:
                 def op(cache=cache, plan=plan, holder=holder,
-                       rid=stream.request_id):
+                       rid=stream.request_id, ts=ts):
                     try:
                         with _telemetry.span("decode.prefill",
                                              domain="serving",
                                              tokens=len(plan.suffix),
-                                             request_id=rid):
+                                             **(ts if ts is not None
+                                                else {"request_id": rid})):
                             pre = self.programs.prefill(plan.suffix)
                             if len(pre) == 5:   # int8 KV: + scale rows
                                 last, k_new, v_new, ks_new, vs_new = pre
@@ -548,13 +601,20 @@ class DecodeScheduler:
         if not admitted:
             return
         _engine.fence(touched).wait()
-        for a, holder in admitted:
+        for a, holder, dctx, t0 in admitted:
             err = holder.get("error")
             if err is not None:
                 self.caches[a.replica].free(a.slot)
                 a.stream._fail(ServingError(
                     "prefill failed: %s" % err, code="dispatch_error"))
+                self._stream_end(a.stream, ok=False, code="dispatch_error")
                 continue
+            if dctx is not None:
+                # the decode-path dispatch span: push -> first token,
+                # parent of the prefill span recorded on the engine worker
+                _telemetry.complete("serving.dispatch", domain="serving",
+                                    start_ns=t0, kind="prefill",
+                                    replica=a.replica, **dctx.stamps())
             with self._cond:
                 self._active[(a.replica, a.slot)] = a
             self._emit(a, sample_token(holder["logits"], a.temperature,
@@ -616,12 +676,23 @@ class DecodeScheduler:
             holder: Dict[str, object] = {}
             stepped.append((rep, actives, holder))
             touched.append(cache.var)
+            # batch-level span: link every co-resident stream's trace so
+            # each request's tree shows the decode steps it shared
+            step_stamps = None
+            if _telemetry.enabled("serving"):
+                tids = [a.stream.trace.trace_id for a in actives
+                        if a.stream.trace is not None]
+                if tids:
+                    step_stamps = {
+                        "trace_ids": tids,
+                        "span_id": _trace_context.mint_span_id()}
 
             def op(cache=cache, lengths=lengths, tokens=tokens,
-                   tables=tables, holder=holder):
+                   tables=tables, holder=holder, ts=step_stamps):
                 try:
                     with _telemetry.span("decode.step", domain="serving",
-                                         rows=int((lengths > 0).sum())):
+                                         rows=int((lengths > 0).sum()),
+                                         **(ts or {})):
                         if tables is not None:
                             out = self.programs.decode(
                                 cache.k_slab, cache.v_slab, tables,

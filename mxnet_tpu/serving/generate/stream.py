@@ -26,11 +26,12 @@ class TokenStream:
 
     def __init__(self, prompt_len: int, max_new_tokens: int,
                  deadline: Optional[float] = None,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None, trace=None):
         self.prompt_len = prompt_len
         self.max_new_tokens = max_new_tokens
         self.deadline = deadline              # time.monotonic() absolute
         self.request_id = request_id          # caller correlation id
+        self.trace = trace                    # telemetry.TraceContext | None
         self.submitted = time.monotonic()
         self._cond = threading.Condition()
         self._tokens: List[int] = []

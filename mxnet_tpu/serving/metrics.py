@@ -38,6 +38,22 @@ def _percentile(sorted_vals: Sequence[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+#: registry latency histogram buckets (ms) — coarse SLO bands; the
+#: fine-grained percentiles stay on the ServingMetrics windows
+LATENCY_BUCKETS_MS = (1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500)
+
+
+def latency_histogram() -> "telemetry.Histogram":
+    """The process-wide ``serving_request_latency_ms`` histogram. Unlike
+    the windowed percentiles it is cumulative AND carries OpenMetrics
+    exemplars: each bucket remembers the last ``trace_id`` observed into
+    it, so a Prometheus latency bucket links to one concrete request
+    timeline (``GET /debug/requests/<trace_id>``)."""
+    return telemetry.registry.histogram(
+        "serving_request_latency_ms", buckets=LATENCY_BUCKETS_MS,
+        help="end-to-end serving request latency (ms), predict + generate")
+
+
 class ServingMetrics:
     """Thread-safe serving counters with metric.py-style getters."""
 
@@ -95,6 +111,15 @@ class ServingMetrics:
     def record_error(self, code: str):
         with self._lock:
             self.errors[code] = self.errors.get(code, 0) + 1
+
+    def observe_latency(self, latency_ms: Optional[float],
+                        trace_id: Optional[str] = None):
+        """Feed one completed request into the registry latency
+        histogram, attaching the request's trace id as the bucket's
+        exemplar. Lock-free here — the histogram has its own leaf lock."""
+        if latency_ms is not None:
+            latency_histogram().observe(float(latency_ms),
+                                        exemplar=trace_id)
 
     def record_batch(self, rows: int, bucket: int,
                      latencies_ms: Sequence[float]):

@@ -37,6 +37,8 @@ from .. import engine
 from .. import predict as predict_mod
 from .. import progcache as _progcache
 from .. import telemetry
+from ..telemetry import context as trace_context
+from ..telemetry import flight as _flight
 from .batcher import BatchFormer, Request, ServingError
 from .bucket_cache import BucketCache
 from .generate import (DecodeModel, DecodeScheduler, DecodeSpec,
@@ -396,10 +398,17 @@ class InferenceServer:
                 % (rows, max_rows), "too_large")
         t = self.config.timeout_ms if timeout_ms is None else timeout_ms
         deadline = (time.monotonic() + t / 1e3) if t and t > 0 else None
+        # the trace context rides ON the request — the thread-local set
+        # by the HTTP edge doesn't survive the former/engine thread hops
+        trace = trace_context.current_context()
         req = Request(feed, rows, deadline, priority=pri,
-                      request_id=request_id)
-        telemetry.instant("serving.submit", domain="serving", rows=rows,
-                          priority=req.priority, request_id=request_id)
+                      request_id=request_id, trace=trace)
+        if trace is not None and telemetry.enabled("serving"):
+            telemetry.instant("serving.submit", domain="serving", rows=rows,
+                              priority=req.priority, **trace.stamps())
+        else:
+            telemetry.instant("serving.submit", domain="serving", rows=rows,
+                              priority=req.priority, request_id=request_id)
         self.metrics.record_submit(rows)
         try:
             self._former.submit(req)
@@ -440,13 +449,18 @@ class InferenceServer:
                 "decode=GenerateConfig(num_heads=...)")
         if not self._started:
             raise ServingError("server not started", "shutdown")
-        telemetry.instant("serving.submit_stream", domain="serving",
-                          prompt=len(prompt), request_id=request_id)
+        trace = trace_context.current_context()
+        if trace is not None and telemetry.enabled("serving"):
+            telemetry.instant("serving.submit_stream", domain="serving",
+                              prompt=len(prompt), **trace.stamps())
+        else:
+            telemetry.instant("serving.submit_stream", domain="serving",
+                              prompt=len(prompt), request_id=request_id)
         try:
             return self._decode.submit(prompt, max_new_tokens,
                                        timeout_ms=timeout_ms,
                                        temperature=temperature, seed=seed,
-                                       request_id=request_id)
+                                       request_id=request_id, trace=trace)
         except ServingError as e:
             self.metrics.record_error(e.code)
             raise
@@ -484,9 +498,11 @@ class InferenceServer:
                 # queue time per request: submitted is time.monotonic(),
                 # the same clock the tracer stamps in, so the span is exact
                 for r in batch:
+                    extra = (r.trace.child().stamps()
+                             if r.trace is not None else {})
                     telemetry.complete("serving.queued", domain="serving",
                                        start_ns=int(r.submitted * 1e9),
-                                       rows=r.rows)
+                                       rows=r.rows, **extra)
             rep = self._pick_replica()
             self._nbatch += 1
             nbatch = self._nbatch
@@ -593,6 +609,10 @@ class InferenceServer:
                 for r in _batch:
                     if not r.done():
                         r.set_error(err)
+                        _flight.request_end(r.trace, ok=False,
+                                            code=err.code,
+                                            latency_ms=r.latency_ms,
+                                            request_id=r.request_id)
 
         return engine.FuseOp(
             fwd_fn, out_vars=(rep.var,), feed=feed, writeback=writeback,
@@ -701,6 +721,13 @@ class InferenceServer:
                 sp.annotate(bucket=bucket, rows=rows,
                             deadline_margin_ms=(round(min(margins), 3)
                                                 if margins else None))
+                # batch-level span: link every member request's trace so
+                # each request's assembled tree includes the batch it rode
+                tids = [r.trace.trace_id for r in batch
+                        if r.trace is not None]
+                if tids:
+                    sp.annotate(trace_ids=tids,
+                                span_id=trace_context.mint_span_id())
             with telemetry.span("serving.pad", domain="serving",
                                 bucket=bucket, rows=rows):
                 if self.config.zero_copy:
@@ -736,6 +763,9 @@ class InferenceServer:
             for r in batch:
                 if not r.done():
                     r.set_error(err)
+                    _flight.request_end(r.trace, ok=False, code=err.code,
+                                        latency_ms=r.latency_ms,
+                                        request_id=r.request_id)
         finally:
             sp.__exit__(None, None, None)
             on_complete()
@@ -758,6 +788,11 @@ class InferenceServer:
             r.set_result([o[offset:offset + r.rows] for o in outs])
             offset += r.rows
             lats.append(r.latency_ms)
+            self.metrics.observe_latency(
+                r.latency_ms,
+                r.trace.trace_id if r.trace is not None else None)
+            _flight.request_end(r.trace, ok=True, latency_ms=r.latency_ms,
+                                kind="predict", request_id=r.request_id)
         rep.dispatched += 1
         self.metrics.record_batch(rows, bucket, lats)
         if self._batch_end_callback is not None:

@@ -1,6 +1,6 @@
 """mxnet_tpu.telemetry — process-wide tracing + metrics (ISSUE 4).
 
-Two halves, both with branch-and-return disabled paths:
+Four pieces, all with branch-and-return disabled paths:
 
 - **tracing** (:mod:`.tracer`): per-thread ring-buffer span recorder.
   Spans are OFF by default; enable domains with
@@ -9,24 +9,38 @@ Two halves, both with branch-and-return disabled paths:
   drains every buffer into a chrome://tracing JSON.
 - **metrics** (:mod:`.metrics`): the central :data:`registry` of
   counters/gauges/histograms plus adopted metric groups (ServingMetrics
-  et al.), with ``get_name_value()`` and Prometheus ``exposition()``.
+  et al.), with ``get_name_value()`` and Prometheus ``exposition()``
+  (histograms carry OpenMetrics exemplars linking buckets to traces).
   Counters are ON by default; ``MXNET_TELEMETRY=0`` kills everything.
+- **trace context** (:mod:`.context`): W3C ``traceparent`` parse/mint
+  at the HTTP edge, thread-local + object carry through serving and
+  the PS plane, ``trace_id``/``span_id``/``parent_id`` span stamps.
+- **flight recorder** (:mod:`.flight`): always-on bounded ring of
+  completed request timelines; SLO anomalies (deadline miss, shed,
+  compile-after-steady, drain, ``MXNET_SLOW_REQUEST_MS``) write
+  diagnostic bundles to ``MXNET_FLIGHT_DIR``.
 
 See docs/observability.md. Instrumentation must live OUTSIDE
 jitted/shard_mapped functions — enforced by
-``mxnet_tpu.analysis.trace_purity`` (rule ``telemetry-in-jit``).
+``mxnet_tpu.analysis.trace_purity`` (rule ``telemetry-in-jit``), which
+also flags ``current_context()`` reads inside jitted code.
 """
 from .tracer import (begin, chrome_events, clock_ns, complete,
-                     disable_spans, drain_events, enable_spans, enabled,
-                     enabled_domains, end, instant, mark_begin, mark_end,
-                     reset, span)
+                     disable_spans, drain_events, dump_ring, enable_spans,
+                     enabled, enabled_domains, end, instant, mark_begin,
+                     mark_end, reset, set_span_sink, span)
 from .metrics import (CONTENT_TYPE_LATEST, Counter, Gauge, Histogram,
                       Registry, registry)
+from . import context
+from . import flight
+from .context import TraceContext, current_context
 
 __all__ = [
     "span", "begin", "end", "complete", "instant", "mark_begin", "mark_end",
     "enabled", "enable_spans", "disable_spans", "enabled_domains",
-    "drain_events", "chrome_events", "clock_ns", "reset",
+    "drain_events", "chrome_events", "clock_ns", "reset", "dump_ring",
+    "set_span_sink",
     "registry", "Registry", "Counter", "Gauge", "Histogram",
     "CONTENT_TYPE_LATEST",
+    "context", "flight", "TraceContext", "current_context",
 ]

@@ -19,6 +19,7 @@ from __future__ import annotations
 import math
 import re
 import threading
+import time
 import weakref
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -71,12 +72,19 @@ class Counter:
         return [(self.sample_name(), self._value)]
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote and newline (the only three escapes the 0.0.4 grammar has)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _render_labels(labels) -> str:
     """``{k="v",...}`` suffix, keys sorted (stable registry identity)."""
     if not labels:
         return ""
     return "{%s}" % ",".join(
-        '%s="%s"' % (_sanitize(str(k)), str(v).replace('"', "'"))
+        '%s="%s"' % (_sanitize(str(k)), _escape_label_value(v))
         for k, v in sorted(labels.items()))
 
 
@@ -122,10 +130,17 @@ class Gauge:
 
 
 class Histogram:
-    """Cumulative-bucket histogram (Prometheus ``le`` semantics)."""
+    """Cumulative-bucket histogram (Prometheus ``le`` semantics).
+
+    ``observe(v, exemplar="<trace_id>")`` attaches an OpenMetrics
+    exemplar to the bucket the observation lands in (last-write-wins
+    per bucket): the exposition then renders
+    ``... # {trace_id="..."} <value> <unix_ts>`` after the bucket
+    sample, which is how a Prometheus latency bucket links back to one
+    concrete request timeline in the flight recorder."""
 
     __slots__ = ("name", "help", "buckets", "_counts", "_sum", "_count",
-                 "_lock")
+                 "_exemplars", "_lock")
 
     DEFAULT_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100,
                       250, 500, 1000)
@@ -136,11 +151,13 @@ class Histogram:
         self.help = help
         self.buckets = tuple(sorted(buckets)) or self.DEFAULT_BUCKETS
         self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self._exemplars: List[Optional[tuple]] = \
+            [None] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._count = 0
         self._lock = threading.Lock()
 
-    def observe(self, v: float):
+    def observe(self, v: float, exemplar: Optional[str] = None):
         if not _master_enabled():
             return
         i = 0
@@ -152,10 +169,18 @@ class Histogram:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if exemplar:
+                self._exemplars[i] = (str(exemplar), float(v), time.time())
 
     def snapshot(self) -> Tuple[List[int], float, int]:
         with self._lock:
             return list(self._counts), self._sum, self._count
+
+    def exemplars(self) -> List[Optional[tuple]]:
+        """Per-bucket ``(trace_id, value, unix_ts)`` or None (last
+        slot is the +Inf bucket)."""
+        with self._lock:
+            return list(self._exemplars)
 
     def get_name_value(self):
         counts, s, n = self.snapshot()
@@ -278,7 +303,8 @@ class Registry:
                 return
             headed.add(name)
             out.append("# HELP %s %s"
-                       % (name, (help_text or name).replace("\n", " ")))
+                       % (name, (help_text or name)
+                          .replace("\\", "\\\\").replace("\n", "\\n")))
             out.append("# TYPE %s %s" % (name, kind))
 
         for m in metrics:
@@ -294,11 +320,14 @@ class Registry:
             elif isinstance(m, Histogram):
                 _head(name, "histogram", m.help)
                 counts, s, n = m.snapshot()
+                ex = m.exemplars()
                 acc = 0
-                for b, c in zip(m.buckets, counts):
+                for i, (b, c) in enumerate(zip(m.buckets, counts)):
                     acc += c
-                    out.append('%s_bucket{le="%s"} %d' % (name, _fmt(b), acc))
-                out.append('%s_bucket{le="+Inf"} %d' % (name, n))
+                    out.append('%s_bucket{le="%s"} %d%s'
+                               % (name, _fmt(b), acc, _fmt_exemplar(ex[i])))
+                out.append('%s_bucket{le="+Inf"} %d%s'
+                           % (name, n, _fmt_exemplar(ex[-1])))
                 out.append("%s_sum %s" % (name, _fmt(s)))
                 out.append("%s_count %d" % (name, n))
         for prefix, sid, obj in groups:
@@ -313,6 +342,19 @@ class Registry:
         with self._lock:
             self._metrics.clear()
             self._groups.clear()
+
+
+def _fmt_exemplar(ex) -> str:
+    """OpenMetrics exemplar suffix — `` # {trace_id="..."} v ts`` —
+    or the empty string. Exemplars exist only in the OpenMetrics
+    grammar; they appear solely on ``_bucket`` lines of histograms
+    that were observed WITH an exemplar, so every other family stays
+    bitwise 0.0.4 (docs/observability.md, Request tracing)."""
+    if not ex:
+        return ""
+    tid, v, ts = ex
+    return ' # {trace_id="%s"} %s %s' % (
+        _escape_label_value(tid), _fmt(v), repr(float(ts)))
 
 
 def _fmt(v) -> str:

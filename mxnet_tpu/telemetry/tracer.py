@@ -37,8 +37,18 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
-#: per-thread ring size (events beyond it age out oldest-first)
+#: per-thread ring size default (events beyond it age out oldest-first).
+#: MXNET_TELEMETRY_BUFFER is re-read at every ring CREATION — a test or
+#: forked worker can resize without reimporting; threads whose rings
+#: already exist keep their size.
 _BUFFER_SIZE = int(os.environ.get("MXNET_TELEMETRY_BUFFER", "65536"))
+
+
+def _buffer_size() -> int:
+    try:
+        return int(os.environ.get("MXNET_TELEMETRY_BUFFER") or _BUFFER_SIZE)
+    except ValueError:
+        return _BUFFER_SIZE
 
 clock_ns = time.monotonic_ns
 
@@ -52,7 +62,7 @@ class _ThreadBuffer:
     __slots__ = ("events", "tid", "name")
 
     def __init__(self):
-        self.events: deque = deque(maxlen=_BUFFER_SIZE)
+        self.events: deque = deque(maxlen=_buffer_size())
         t = threading.current_thread()
         self.tid = t.ident or 0
         self.name = t.name
@@ -115,6 +125,33 @@ if _env_profiler and _env_profiler not in ("0", "off", "none"):
 del _env_profiler
 
 
+# --- span sink (flight recorder tee) -----------------------------------------
+_span_sink = None
+
+
+def set_span_sink(fn):
+    """Install ``fn(ph, name, domain, ts_ns, dur_ns, args)``, invoked on
+    the recording thread for every completed event whose args carry
+    ``trace_id``/``trace_ids`` stamps — the flight recorder's feed
+    (telemetry.flight installs itself at import). Only runs when spans
+    are ON: the disabled path never reaches it. Returns the prior sink."""
+    global _span_sink
+    prev = _span_sink
+    _span_sink = fn
+    return prev
+
+
+def _tee(ph, name, domain, ts_ns, dur_ns, args):
+    s = _span_sink
+    if (s is None or args is None
+            or ("trace_id" not in args and "trace_ids" not in args)):
+        return
+    try:
+        s(ph, name, domain, ts_ns, dur_ns, args)
+    except Exception:
+        pass  # a broken sink must never take down the traced code
+
+
 # --- event recording ---------------------------------------------------------
 # raw event: (ph, name, domain, ts_ns, dur_ns, args_or_None)
 class _Span:
@@ -140,6 +177,7 @@ class _Span:
         t1 = clock_ns()
         _buf().events.append(
             ("X", self.name, self.domain, self.t0, t1 - self.t0, self.args))
+        _tee("X", self.name, self.domain, self.t0, t1 - self.t0, self.args)
         return False
 
 
@@ -190,7 +228,9 @@ def end(token: Optional[tuple], **extra_args):
     end_tid = threading.get_ident()
     if end_tid != buf.tid:
         args = dict(args or (), end_tid=end_tid)
-    buf.events.append(("X", name, domain, t0, clock_ns() - t0, args))
+    dur = clock_ns() - t0
+    buf.events.append(("X", name, domain, t0, dur, args))
+    _tee("X", name, domain, t0, dur, args)
 
 
 def complete(name: str, domain: str = "app", start_ns: int = 0,
@@ -201,15 +241,20 @@ def complete(name: str, domain: str = "app", start_ns: int = 0,
     if not (_spans_on and (_all_domains or domain in _domains)):
         return
     t1 = clock_ns() if end_ns is None else end_ns
+    a = args or None
     _buf().events.append(
-        ("X", name, domain, start_ns, max(0, t1 - start_ns), args or None))
+        ("X", name, domain, start_ns, max(0, t1 - start_ns), a))
+    _tee("X", name, domain, start_ns, max(0, t1 - start_ns), a)
 
 
 def instant(name: str, domain: str = "app", **args):
     """Record an instant ("i") event — a point-in-time marker."""
     if not (_spans_on and (_all_domains or domain in _domains)):
         return
-    _buf().events.append(("i", name, domain, clock_ns(), 0, args or None))
+    t = clock_ns()
+    a = args or None
+    _buf().events.append(("i", name, domain, t, 0, a))
+    _tee("i", name, domain, t, 0, a)
 
 
 def mark_begin(name: str, domain: str = "app", **args):
@@ -275,6 +320,29 @@ def chrome_events(clear: bool = True) -> List[dict]:
     meta = [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
              "args": {"name": tname}} for tid, tname in seen_tids.items()]
     return meta + evs
+
+
+def dump_ring(dir: Optional[str] = None) -> Optional[str]:
+    """Drain this process's ring buffers to a pid-tagged file
+    ``<dir>/telemetry_ring_<pid>.json`` (a chrome ``traceEvents`` list;
+    pid rides every event). Worker processes — dist kvstore servers,
+    dryrun subprocesses — call this at exit (or automatically when
+    ``MXNET_TELEMETRY_RING_DIR`` is set), and ``profiler.dump_profile()``
+    merges every ring file it finds into the single trace. Returns the
+    path, or None when no directory is configured."""
+    import json
+
+    d = dir or os.environ.get("MXNET_TELEMETRY_RING_DIR")
+    if not d:
+        return None
+    evs = chrome_events(clear=True)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, "telemetry_ring_%d.json" % os.getpid())
+    tmp = "%s.%d.tmp" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(evs, f)
+    os.replace(tmp, path)
+    return path
 
 
 def reset():

@@ -4,12 +4,16 @@ imported — parsed only.
 ``instrumented_step`` opens a telemetry span and bumps a registry counter
 inside an ``@jax.jit`` function — both run at trace time only (rule
 ``telemetry-in-jit``); ``make_sharded`` does it in a fn passed to
-``shard_map`` by name. ``clean_host_step`` instruments the HOST wrapper
-around the jitted call and must NOT be flagged.
+``shard_map`` by name; ``stamped_step`` reads the request trace context
+through a BARE from-import (``current_context()``) — the thread-local
+read is baked into the cached trace as a constant. ``clean_host_step``
+instruments the HOST wrapper around the jitted call and must NOT be
+flagged.
 """
 import jax
 
 from mxnet_tpu import telemetry
+from mxnet_tpu.telemetry.context import current_context
 
 
 @jax.jit
@@ -28,6 +32,13 @@ def make_sharded(mesh):
     from jax.experimental.shard_map import shard_map
 
     return shard_map(step, mesh=mesh, in_specs=None, out_specs=None)
+
+
+@jax.jit
+def stamped_step(params, grads):
+    ctx = current_context()                            # trace-time only
+    new = params - 0.1 * grads
+    return new if ctx is None else new
 
 
 def clean_host_step(jitted, counter):

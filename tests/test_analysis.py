@@ -74,6 +74,10 @@ def test_telemetry_in_jit_fixture_flags_trace_time_instrumentation():
         {"instrumented_step", "step"}
     assert any("telemetry.span" in f.subject for f in hits)
     assert any("telemetry.registry.counter" in f.subject for f in hits)
+    # a BARE from-imported current_context() in a jitted fn is caught
+    # (the thread-local read would be baked in as a trace constant)
+    assert any("stamped_step" in f.qualname
+               and f.subject == "current_context" for f in hits)
     # the host-side wrapper (not traced) is NOT flagged
     assert all("run" not in f.qualname for f in hits)
 

@@ -224,3 +224,47 @@ def test_wire_framing_roundtrip_edge_shapes():
                 np.testing.assert_array_equal(g, want)
             else:
                 assert g == want
+
+
+def test_ps_rpcs_carry_client_trace_context():
+    """A worker's trace context rides the PS wire (ISSUE 19): the
+    server-side push/pull spans land in the SAME trace as the client,
+    assembled by the flight recorder; control traffic stays untraced."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.telemetry import context as tctx
+    from mxnet_tpu.telemetry import flight
+
+    addr = ("127.0.0.1", _free_port())
+    server = KVStoreServer(address=addr, n_workers=1, sync_mode=False)
+    server.start_background()
+    prev = telemetry.enabled_domains()
+    telemetry.enable_spans("kvstore")
+    flight.reset()
+    try:
+        c = PSClient(addr)
+        c.set_optimizer(mx.optimizer.Test(rescale_grad=1.0))
+        ctx = tctx.mint(request_id="step7")
+        with tctx.use(ctx):
+            c.init("w", np.zeros((4,), np.float32))
+            c.push("w", np.ones((4,), np.float32))
+            np.testing.assert_allclose(c.pull("w"), np.ones(4), rtol=1e-6)
+        # server spans close just after each reply is sent; poll briefly
+        deadline = time.monotonic() + 10
+        names = set()
+        while time.monotonic() < deadline:
+            tree = flight.request_tree(ctx.trace_id)
+            if tree is not None:
+                names = {s["name"] for s in tree["spans"]}
+                if {"kvstore.push", "kvstore.pull"} <= names:
+                    break
+            time.sleep(0.01)
+        assert {"kvstore.init", "kvstore.push", "kvstore.pull"} <= names, \
+            names
+        assert not any("hello" in n or "heartbeat" in n for n in names)
+        c.stop()
+    finally:
+        if prev:
+            telemetry.enable_spans(prev)
+        else:
+            telemetry.disable_spans()
+        flight.reset()

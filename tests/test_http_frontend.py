@@ -15,6 +15,7 @@ import base64
 import http.client
 import json
 import os
+import re
 import signal
 import threading
 import time
@@ -513,3 +514,240 @@ def test_exposition_help_and_type_for_every_family():
     assert sum(1 for l in lines if l.startswith("# TYPE g_lab ")) == 1
     assert "# HELP bare_total bare_total" in text  # name fallback
     assert telemetry.CONTENT_TYPE_LATEST.startswith("text/plain")
+
+
+# --- request tracing + flight recorder (ISSUE 19) ----------------------------
+
+TP = "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01"
+TID = "4bf92f3577b34da6a3ce929d0e0e4736"
+
+
+@pytest.fixture()
+def traced(tmp_path, monkeypatch):
+    """Spans on for the serving domain + an isolated flight dir."""
+    from mxnet_tpu.telemetry import flight
+    monkeypatch.setenv("MXNET_FLIGHT_DIR", str(tmp_path / "flight"))
+    prev = telemetry.enabled_domains()
+    telemetry.enable_spans("serving")
+    flight.reset()
+    yield flight
+    if prev:
+        telemetry.enable_spans(prev)
+    else:
+        telemetry.disable_spans()
+    flight.reset()
+
+
+def _walk_spans(spans, fn):
+    for s in spans:
+        fn(s)
+        _walk_spans(s.get("children") or [], fn)
+
+
+def test_traceparent_assembles_one_tree_with_exemplar(traced):
+    """The ISSUE acceptance path: a traced /v1/generate leaves ONE
+    assembled span tree (queued -> dispatch -> decode.step, recorded on
+    distinct threads) addressable by request id AND trace id, with the
+    same trace id riding the latency histogram as an exemplar."""
+    fe, _ = _lm_frontend(max_new_tokens=4)
+    with fe:
+        st, hdrs, events = _sse(
+            fe.port, {"prompt": [3, 7, 1], "max_new_tokens": 4},
+            headers={"traceparent": TP, "x-request-id": "tr-1"})
+        assert st == 200
+        assert hdrs["x-trace-id"] == TID
+        # the response hop carries OUR span id, never the caller's
+        assert hdrs["traceparent"].startswith("00-%s-" % TID)
+        assert "00f067aa0ba902b7" not in hdrs["traceparent"]
+        assert events[-1][0] == "done"
+        # request_end fires on the scheduler thread right after the done
+        # frame goes out; poll briefly for the assembled tree
+        deadline = time.monotonic() + 30
+        while True:
+            st, _, tree = _req(fe.port, "GET", "/debug/requests/tr-1")
+            if st == 200 or time.monotonic() > deadline:
+                break
+            time.sleep(0.01)
+        assert st == 200, tree
+        assert tree["trace_id"] == TID and tree["ok"] is True
+        names, tids = set(), set()
+        _walk_spans(tree["spans"], lambda s: (names.add(s["name"]),
+                                              tids.add(s["tid"])))
+        assert {"serving.queued", "serving.dispatch",
+                "decode.step"} <= names, names
+        assert len(tids) >= 2          # spans from distinct threads
+        # the same tree is addressable by trace id
+        st, _, by_trace = _req(fe.port, "GET", "/debug/requests/" + TID)
+        assert st == 200 and by_trace["trace_id"] == TID
+        # the latency histogram links back via an OpenMetrics exemplar
+        st, _, raw = _req(fe.port, "GET", "/metrics")
+        text = raw.decode("utf-8")
+        pat = (r'serving_request_latency_ms_bucket\{le="[^"]+"\} \d+'
+               r' # \{trace_id="%s"\}' % TID)
+        assert re.search(pat, text), text
+        # /debug/flight: recorder summary with the completed request
+        st, _, summ = _req(fe.port, "GET", "/debug/flight")
+        assert st == 200 and summ["enabled"]
+        assert any(r["request_id"] == "tr-1" and r["trace_id"] == TID
+                   for r in summ["ring"])
+        st, _, body = _req(fe.port, "GET", "/debug/requests/absent")
+        assert st == 404 and body["error"]["code"] == "not_found"
+
+
+def test_errors_echo_trace_id_in_body_and_headers(traced):
+    fe, _ = _mlp_frontend()
+    with fe:
+        st, hdrs, body = _req(fe.port, "POST", "/v1/predict",
+                              body={"x": 1}, headers={"traceparent": TP})
+        assert st == 400 and body["error"]["code"] == "bad_request"
+        assert body["trace_id"] == TID
+        assert hdrs["x-trace-id"] == TID
+        assert hdrs["traceparent"].startswith("00-%s-" % TID)
+        # a malformed traceparent is IGNORED per W3C spec: the error
+        # still carries a (freshly minted) trace id, never a 4xx for it
+        st, _, body = _req(fe.port, "POST", "/v1/predict",
+                           body={"x": 1},
+                           headers={"traceparent": "not-a-traceparent"})
+        assert st == 400
+        assert len(body["trace_id"]) == 32 and body["trace_id"] != TID
+        # GET routes have no request trace: no trace_id key at all
+        st, _, body = _req(fe.port, "GET", "/nope")
+        assert st == 404 and "trace_id" not in body
+
+
+def test_sse_error_event_carries_trace_id(traced):
+    """A mid-stream failure travels in-band as an SSE `error` event and
+    still echoes the trace id (the stream already holds a 200)."""
+    fe, _ = _lm_frontend(max_new_tokens=64)
+    with fe:
+        # a cold scheduler never reject-earlies; the 50 ms deadline then
+        # expires during the first prefill compile -> in-band error
+        st, hdrs, resp = _sse(
+            fe.port, {"prompt": [3, 7, 1], "max_new_tokens": 64},
+            headers={"traceparent": TP, "timeout-ms": "50"})
+        if st == 200:
+            errs = [d for e, d in resp if e == "error"]
+            assert errs, resp
+            assert errs[0]["code"] == "deadline_exceeded"
+            assert errs[0]["trace_id"] == TID
+        else:   # submit-side rejection: the JSON error echoes it too
+            assert resp["trace_id"] == TID
+
+
+# --- strict exposition conformance (ISSUE 19 satellite) ----------------------
+
+_VALUE = r"(?:NaN|[+-]?Inf|[+-]?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)"
+_LVAL = r'(?:[^"\\\n]|\\[\\"n])*'          # only \\ \" \n escapes exist
+_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="%s"' % _LVAL
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(\{%s(?:,%s)*\})?'
+    r' (%s)'
+    r'( # \{trace_id="%s"\} %s %s)?$'
+    % (_LABEL, _LABEL, _VALUE, _LVAL, _VALUE, _VALUE))
+
+
+def _assert_prometheus_conformant(text):
+    """Line-by-line strict parse of a text-format 0.0.4 body (plus the
+    OpenMetrics exemplar suffix): HELP/TYPE framing precedes every
+    sample of its family, label values use only the three legal
+    escapes, histogram buckets are cumulative with +Inf == _count, and
+    exemplars appear only on histogram _bucket lines."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    typed, helped = {}, set()
+    buckets, counts, sums = {}, {}, {}
+    for line in text.splitlines():
+        assert line.strip(), "blank line in exposition"
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            assert len(parts) >= 3, line
+            fam = parts[2]
+            assert fam not in helped, "duplicate HELP for " + fam
+            helped.add(fam)
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert len(parts) == 4, line
+            fam, kind = parts[2], parts[3]
+            assert kind in ("counter", "gauge", "histogram", "summary",
+                            "untyped"), line
+            assert fam not in typed, "duplicate TYPE for " + fam
+            typed[fam] = kind
+            continue
+        assert not line.startswith("#"), "stray comment: " + line
+        m = _SAMPLE_RE.match(line)
+        assert m, "unparseable sample line: %r" % line
+        name, labels, value, exemplar = m.groups()
+        fam = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in typed:
+                fam = name[: -len(suffix)]
+                break
+        # framing must PRECEDE the family's first sample
+        assert fam in typed, "sample before # TYPE: " + line
+        assert fam in helped, "sample before # HELP: " + line
+        if exemplar:
+            assert typed[fam] == "histogram" and name.endswith("_bucket"), \
+                "exemplar outside a histogram bucket: " + line
+        if typed[fam] == "histogram":
+            if name.endswith("_bucket"):
+                le = re.search(r'le="([^"]*)"', labels or "")
+                assert le, "bucket without le label: " + line
+                buckets.setdefault(fam, []).append(
+                    (le.group(1), int(value)))
+            elif name.endswith("_count"):
+                counts[fam] = int(value)
+            elif name.endswith("_sum"):
+                sums[fam] = value
+    assert typed and helped
+    for fam, bks in buckets.items():
+        assert fam in counts and fam in sums, fam + " missing sum/count"
+        les = [le for le, _ in bks]
+        vals = [v for _, v in bks]
+        assert les[-1] == "+Inf", fam + " last bucket must be +Inf"
+        assert les.count("+Inf") == 1
+        assert all(a <= b for a, b in zip(vals, vals[1:])), \
+            fam + " buckets must be cumulative"
+        assert vals[-1] == counts[fam], \
+            fam + " +Inf bucket must equal _count"
+
+
+def test_live_metrics_body_is_strictly_conformant(traced):
+    """The FULL /metrics body — every family the process exports,
+    including traced-traffic exemplars — survives a strict parse."""
+    fe, _ = _mlp_frontend()
+    x = np.zeros((1, 10), np.float32)
+    with fe:
+        st, _, _b = _req(fe.port, "POST", "/v1/predict",
+                         body={"inputs": {"data": x.tolist()}},
+                         headers={"traceparent": TP})
+        assert st == 200
+        st, hdrs, raw = _req(fe.port, "GET", "/metrics")
+        assert st == 200
+    text = raw.decode("utf-8")
+    _assert_prometheus_conformant(text)
+    assert "serving_request_latency_ms_bucket" in text
+
+
+def test_exposition_conformant_under_hostile_labels_and_help():
+    reg = telemetry.Registry()
+    reg.counter("c_total", help="multi\nline \\ help").inc()
+    reg.gauge("g", labels={"path": 'a"b\\c\nd'}).set(1)
+    reg.gauge("nan_g").set(float("nan"))
+    h = reg.histogram("h_ms", buckets=(1, 10))
+    h.observe(0.5)
+    h.observe(5, exemplar='tr"ace\\id')
+    h.observe(100)
+    text = reg.exposition()
+    _assert_prometheus_conformant(text)
+    # the hostile label survives escaped, on one line
+    assert '{path="a\\"b\\\\c\\nd"}' in text
+    assert "# HELP c_total multi\\nline \\\\ help" in text
+    # and the parser itself REJECTS the classic violations
+    for bad in ("m_no_type 1\n",
+                "# TYPE h histogram\n# HELP h h\n"
+                'h_bucket{le="1"} 2\nh_bucket{le="+Inf"} 1\n'
+                "h_sum 3\nh_count 1\n",
+                '# HELP b b\n# TYPE b counter\nb{l="x\ny"} 1\n'):
+        with pytest.raises(AssertionError):
+            _assert_prometheus_conformant(bad)

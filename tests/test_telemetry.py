@@ -360,3 +360,42 @@ def test_trace_dump_roundtrip_covers_engine_serving_kvstore(tmp_path):
     # a second dump only contains newer events (buffers drained)
     data2 = json.load(open(profiler.dump_profile()))
     assert len(data2["traceEvents"]) < len(evs)
+
+
+# --- ISSUE 19 satellites: buffer env re-read + exemplars --------------------
+
+def test_buffer_size_env_is_reread_at_ring_creation(monkeypatch):
+    """MXNET_TELEMETRY_BUFFER applies to rings created AFTER the env
+    change (a fresh thread's first span), not only at import."""
+    monkeypatch.setenv("MXNET_TELEMETRY_BUFFER", "32")
+    out = []
+    t = threading.Thread(target=lambda: out.append(
+        tracer._buf().events.maxlen))
+    t.start()
+    t.join()
+    assert out == [32]
+    # a bogus value falls back to the import-time default, not a crash
+    monkeypatch.setenv("MXNET_TELEMETRY_BUFFER", "not-a-number")
+    out2 = []
+    t = threading.Thread(target=lambda: out2.append(
+        tracer._buf().events.maxlen))
+    t.start()
+    t.join()
+    assert out2 == [tracer._BUFFER_SIZE]
+
+
+def test_histogram_exemplar_renders_only_on_observed_bucket():
+    h = telemetry.registry.histogram("exm_ms", buckets=(1, 10))
+    h.observe(0.5)                       # no exemplar
+    h.observe(5, exemplar="ab" * 16)     # exemplar on the le=10 bucket
+    h.observe(5000, exemplar='tr"icky')  # +Inf bucket; quote escaped
+    lines = {l.split(" ", 1)[0]: l
+             for l in telemetry.registry.exposition().splitlines()
+             if l.startswith("exm_ms_bucket")}
+    assert '# {trace_id="%s"} 5 ' % ("ab" * 16) in \
+        lines['exm_ms_bucket{le="10"}']
+    assert "#" not in lines['exm_ms_bucket{le="1"}']
+    assert '# {trace_id="tr\\"icky"} 5000 ' in \
+        lines['exm_ms_bucket{le="+Inf"}']
+    # cumulative counts are unchanged by exemplars
+    assert lines['exm_ms_bucket{le="+Inf"}'].split(" ")[1] == "3"

@@ -1,0 +1,100 @@
+"""telemetry.context — W3C traceparent parsing, minting, thread carry.
+
+Acceptance gates (ISSUE 19): a valid inbound ``traceparent`` is honored
+(same trace_id, caller's span becomes the parent); every malformation is
+*ignored* per spec (fresh context, never an error); ``child()`` chains
+parent ids so trees assemble; ``use()`` is the re-entrant thread-local
+carry with a one-getattr off path.
+"""
+import threading
+
+from mxnet_tpu.telemetry import context as tctx
+
+VALID = "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"
+
+
+def test_parse_valid_traceparent_honors_trace_and_parents_caller():
+    ctx = tctx.parse_traceparent(VALID)
+    assert ctx is not None
+    assert ctx.trace_id == "0af7651916cd43dd8448eb211c80319c"
+    assert ctx.parent_id == "b7ad6b7169203331"
+    # OUR side gets a fresh span id, never the caller's
+    assert ctx.span_id != ctx.parent_id and len(ctx.span_id) == 16
+    assert ctx.sampled is True
+    assert tctx.parse_traceparent(VALID.replace("-01", "-00")).sampled \
+        is False
+
+
+def test_parse_rejects_malformed_headers_by_returning_none():
+    bad = [
+        None, "", "garbage",
+        "00-abc-def-01",                                   # short fields
+        VALID + "-extra",                                  # 5 segments
+        "ff-" + VALID[3:],                                 # version ff
+        "00-" + "0" * 32 + "-b7ad6b7169203331-01",         # zero trace
+        "0af7651916cd43dd8448eb211c80319c".join(["00-", "-" + "0" * 16
+                                                 + "-01"]),  # zero span
+        VALID.replace("0af7", "zzzz"),                     # non-hex
+    ]
+    for h in bad:
+        assert tctx.parse_traceparent(h) is None, h
+
+
+def test_to_traceparent_roundtrip():
+    ctx = tctx.mint()
+    wire = tctx.to_traceparent(ctx)
+    back = tctx.parse_traceparent(wire)
+    assert back.trace_id == ctx.trace_id
+    assert back.parent_id == ctx.span_id  # we become the parent hop
+
+
+def test_child_chains_parent_ids_and_keeps_identity():
+    root = tctx.mint(request_id="req1")
+    c1 = root.child()
+    c2 = c1.child()
+    assert c1.trace_id == c2.trace_id == root.trace_id
+    assert c1.parent_id == root.span_id
+    assert c2.parent_id == c1.span_id
+    assert c2.request_id == "req1"
+    s = c1.stamps()
+    assert s == {"trace_id": root.trace_id, "span_id": c1.span_id,
+                 "parent_id": root.span_id, "request_id": "req1"}
+    # root stamps omit the absent parent key entirely
+    assert "parent_id" not in root.stamps()
+
+
+def test_mint_span_ids_unique_and_16_hex():
+    ids = {tctx.mint_span_id() for _ in range(1000)}
+    assert len(ids) == 1000
+    assert all(len(i) == 16 and int(i, 16) >= 0 for i in ids)
+
+
+def test_from_headers_honors_x_request_id_and_traceparent():
+    ctx = tctx.from_headers({"traceparent": VALID, "x-request-id": "abc"})
+    assert ctx.trace_id == "0af7651916cd43dd8448eb211c80319c"
+    assert ctx.request_id == "abc"
+    # no header at all: everything minted
+    fresh = tctx.from_headers({})
+    assert len(fresh.trace_id) == 32 and len(fresh.request_id) == 16
+
+
+def test_use_is_reentrant_and_thread_local():
+    assert tctx.current_context() is None
+    a, b = tctx.mint(), tctx.mint()
+    with tctx.use(a):
+        assert tctx.current_context() is a
+        with tctx.use(b):
+            assert tctx.current_context() is b
+        assert tctx.current_context() is a  # restored, not cleared
+    assert tctx.current_context() is None
+
+    seen = []
+
+    def other():
+        seen.append(tctx.current_context())
+
+    with tctx.use(a):
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+    assert seen == [None]  # contexts never leak across threads
