@@ -36,7 +36,8 @@ never printed both bare and embedded.
 vs_baseline = MFU / 0.45 (the BASELINE.md north-star target) when
 MFU is computable, else img_per_sec / 181.53 (P100 reference row).
 BENCH_MODEL=resnet|transformer restricts the run (the restricted
-workload's record is then the last line).
+workload's record is then the last line); BENCH_MODEL=conv runs the
+per-layer conv-stack layout microbench (run_conv_config) instead.
 
 Design: the whole training step is TWO jitted XLA computations fused into
 ONE program via Executor.make_train_step — forward+backward from the
@@ -2166,6 +2167,123 @@ def run_zero_config():
     return rec
 
 
+
+
+def run_conv_config(batch=None, iters=None, repeats=None):
+    """Per-layer conv-stack layout microbench (BENCH_MODEL=conv,
+    ISSUE 20): each representative ResNet-50 conv shape runs fwd+bwd
+    under BOTH MXNET_CONV_LAYOUT arms, interleaved inside every repeat
+    so the arms share thermal/clock conditions, and the record carries
+    the per-shape PAIRED ratio (nchw_time / nhwc_time — > 1.0 means the
+    NHWC island wins) with outputs and gradients allclose-asserted
+    between arms. One JSON line per shape plus a stack headline."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    batch = batch or int(os.environ.get("BENCH_CONV_BATCH", min(BATCH, 64)))
+    iters = iters or max(3, min(ITERS, 20))
+    repeats = repeats or REPEATS
+    # representative ResNet-50 @224 conv shapes, one per family: the
+    # s2d-eligible stem, each stage's 3x3, and the bandwidth-bound 1x1s
+    shapes = [
+        ("stem7x7", 3, 224, 64, (7, 7), (2, 2), (3, 3)),
+        ("s1_1x1", 64, 56, 64, (1, 1), (1, 1), (0, 0)),
+        ("s1_3x3", 64, 56, 64, (3, 3), (1, 1), (1, 1)),
+        ("s1_expand", 64, 56, 256, (1, 1), (1, 1), (0, 0)),
+        ("s2_3x3", 128, 28, 128, (3, 3), (1, 1), (1, 1)),
+        ("s3_3x3", 256, 14, 256, (3, 3), (1, 1), (1, 1)),
+        ("s4_3x3", 512, 7, 512, (3, 3), (1, 1), (1, 1)),
+    ]
+
+    def build(layout, cin, hw, k, kernel, stride, pad):
+        prev = os.environ.get("MXNET_CONV_LAYOUT")
+        os.environ["MXNET_CONV_LAYOUT"] = layout
+        try:
+            data = mx.sym.Variable("data")
+            sym = mx.sym.Convolution(data, kernel=kernel, stride=stride,
+                                     pad=pad, num_filter=k, no_bias=True,
+                                     name="conv")
+            f = sym.build_eval()
+        finally:
+            if prev is None:
+                os.environ.pop("MXNET_CONV_LAYOUT", None)
+            else:
+                os.environ["MXNET_CONV_LAYOUT"] = prev
+
+        def loss(args):
+            outs, _ = f(args, {}, True, jax.random.PRNGKey(0))
+            return sum(jnp.sum(o * o) for o in outs)
+
+        return jax.jit(jax.value_and_grad(loss))
+
+    rows = []
+    for name, cin, hw, k, kernel, stride, pad in shapes:
+        rng = np.random.RandomState(0)
+        args = {
+            "data": jnp.asarray(rng.uniform(-1, 1, (batch, cin, hw, hw))
+                                .astype(np.float32)),
+            "conv_weight": jnp.asarray(
+                rng.uniform(-0.1, 0.1, (k, cin) + tuple(kernel))
+                .astype(np.float32)),
+        }
+        arms = {lay: build(lay, cin, hw, k, kernel, stride, pad)
+                for lay in ("nchw", "nhwc")}
+        # parity gate before timing: same loss, same grads
+        vals = {lay: arms[lay](args) for lay in arms}
+        np.testing.assert_allclose(
+            float(vals["nchw"][0]), float(vals["nhwc"][0]),
+            rtol=1e-4, err_msg=name)
+        for key_ in vals["nchw"][1]:
+            np.testing.assert_allclose(
+                np.asarray(vals["nchw"][1][key_]),
+                np.asarray(vals["nhwc"][1][key_]),
+                rtol=5e-3, atol=5e-3, err_msg="%s %s" % (name, key_))
+
+        def run_block(fn_, n):
+            v = g = None
+            for _ in range(n):
+                v, g = fn_(args)
+            np.asarray(jnp.reshape(next(iter(g.values())), (-1,))[0])
+
+        for lay in arms:
+            run_block(arms[lay], WARMUP)
+        times = {"nchw": [], "nhwc": []}
+        for _ in range(repeats):
+            for lay in ("nchw", "nhwc"):  # back-to-back inside the repeat
+                t0 = time.perf_counter()
+                run_block(arms[lay], iters)
+                times[lay].append((time.perf_counter() - t0) / iters)
+        ratio = statistics.median(
+            a / b for a, b in zip(times["nchw"], times["nhwc"]))
+        rows.append({
+            "metric": "conv_layout_r50_%s_bs%d" % (name, batch),
+            "value": round(ratio, 4),
+            "unit": "nchw_over_nhwc_fwdbwd_time_ratio",
+            "shape": "Cin=%d HW=%d K=%d k=%s s=%s" % (
+                cin, hw, k, kernel, stride),
+            "nchw_ms": round(statistics.median(times["nchw"]) * 1e3, 3),
+            "nhwc_ms": round(statistics.median(times["nhwc"]) * 1e3, 3),
+            "timing": "interleaved arms, median of %d paired repeats x "
+                      "%d fwd+bwd steps, allclose-gated" % (repeats, iters),
+        })
+        _emit(rows[-1])
+    import math
+    geo = math.exp(sum(math.log(r["value"]) for r in rows) / len(rows))
+    head = {
+        "metric": "conv_layout_stack_bs%d" % batch,
+        "value": round(geo, 4),
+        "unit": "geomean_nchw_over_nhwc_fwdbwd_time_ratio",
+        "shapes": len(rows),
+        "gate": "NHWC island >= NCHW per shape on TPU (ISSUE 20); "
+                "> 1.0 means channels-last wins",
+    }
+    _emit(head)
+    return head
+
+
 def main():
     try:
         _main()
@@ -2202,6 +2320,9 @@ def _main():
         return
     if which == "zero":
         _emit(run_zero_config())
+        return
+    if which == "conv":
+        run_conv_config()
         return
     if os.environ.get("BENCH_LM_SWEEP"):
         # transformer (bs, seq) MFU table (docs/perf.md); one JSON line

@@ -75,7 +75,10 @@ JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; g.dryrun_progcache()"
 # weights must be BITWISE identical across all three; the fused arms run
 # under MXNET_ENGINE_SANITIZER=1 with zero reports; and a warm process
 # over the same progcache dir must disk-load the fused executable with
-# zero fresh fuse compiles.
+# zero fresh fuse compiles. A second pass repeats replay/fused/warm at
+# ZeRO stage 3 (ISSUE 20): the sharded step must STAGE (fused_runs > 0,
+# no bail), match replay bitwise, and warm-restart from the progcache
+# with 0 fresh fused compiles under MXNET_COMPILE_WITNESS=1.
 JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; g.dryrun_fuse()"
 # Quantized-inference gate (ISSUE 14): int8-weight + int8-KV paged decode
 # streams must be bitwise-identical to sequential quantized generation and

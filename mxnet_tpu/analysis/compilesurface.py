@@ -95,7 +95,9 @@ PROGRAM_BUDGETS: Dict[str, str] = {
         "chain-K folds K sub-steps into the one program.",
     "engine.FusedSequence":
         "1 per stabilized capture signature, progcache-keyed by the "
-        "fused lowered text.",
+        "fused lowered text; carry/feed avals fold in the committed "
+        "sharding signature, so a ZeRO stage or mesh change is a new "
+        "signature (re-stage), never a silent respecialization.",
 }
 
 #: names whose presence as a traced-fn FREE variable means weights are

@@ -586,6 +586,31 @@ def _count_fuse_bail(kind: str):
     _fuse_bails_counter.inc()
 
 
+def _sharding_sig(leaf):
+    """Stable signature of a committed jax.sharding, or None.
+
+    Sharded carries (MXNET_SHARDED_UPDATE stages 1-3) lower into the
+    fused program with their NamedSharding baked into the executable, so
+    the placement must be part of the staging aval: a progcache entry
+    serialized for one mesh/spec must never be handed a differently
+    placed carry, and a placement change must re-stage rather than feed
+    a stale program. Single-device / uncommitted / non-jax leaves all
+    map to None so the unsharded path's keys are unchanged.
+    """
+    sh = getattr(leaf, "sharding", None)
+    if sh is None:
+        return None
+    try:
+        import jax
+        if not isinstance(sh, jax.sharding.NamedSharding):
+            return None
+        mesh = sh.mesh
+        return (tuple(mesh.axis_names), tuple(mesh.devices.shape),
+                str(sh.spec))
+    except Exception:
+        return None
+
+
 class FusedSequence:
     """One stable :class:`CapturedSequence` lowered into ONE jitted XLA
     program (``MXNET_ENGINE_FUSE``; ROADMAP trace-and-fuse).
@@ -713,8 +738,14 @@ class FusedSequence:
                                         len(out_idx[i])))
                 for k, val in zip(out_idx[i], res):
                     regs[k] = val
-            return ({k: regs[k] for k in carried_idx},
-                    {k: regs[k] for k in mat_idx})
+            # materialized registers BEFORE the carry: with the carry
+            # donated, XLA pairs donated buffers to outputs in flattened
+            # output order, and the unfused step emits its outputs ahead
+            # of the updated params/states — matching that order keeps
+            # the fused program's buffer aliasing (and therefore its CPU
+            # SPMD codegen) bitwise-identical to the replay arm's.
+            return ({k: regs[k] for k in mat_idx},
+                    {k: regs[k] for k in carried_idx})
 
         # 4. lower + compile-or-disk-load, keyed by the capture signature
         jitted = jax.jit(fused, donate_argnums=(0,))
@@ -754,11 +785,16 @@ class FusedSequence:
 
     @staticmethod
     def _aval(leaf):
+        # (shape, dtype, sharding) — the sharding leg keys the staged
+        # program (and its progcache entry) to the carry placement so
+        # ZeRO stage-1/2/3 runs fuse instead of bailing; see
+        # ``_sharding_sig``. None everywhere on the unsharded path.
         if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
-            return (tuple(leaf.shape), str(leaf.dtype))
+            return (tuple(leaf.shape), str(leaf.dtype),
+                    _sharding_sig(leaf))
         import numpy as np
         a = np.asarray(leaf)
-        return (tuple(a.shape), str(a.dtype))
+        return (tuple(a.shape), str(a.dtype), None)
 
     def _eval_feeds(self, fuses) -> tuple:
         import jax
@@ -822,7 +858,7 @@ class FusedSequence:
         else:
             feeds = self._eval_feeds(fuses)
         try:
-            new_carry, mats = self._exe(self._carry, feeds)
+            mats, new_carry = self._exe(self._carry, feeds)
         except Exception as e:
             raise _FuseBail("fused executable failed: %s" % e)
         self._carry = new_carry

@@ -520,9 +520,12 @@ class Executor:
 
         # trace-and-fuse metadata (engine.FuseOp): the pure `step` plus the
         # facts a consumer needs to stage it into a fused CapturedSequence.
-        # AUTO-layout and ZeRO-1 paths keep their own compiled artifacts
-        # (learned formats / sharded placement) that a re-trace inside a
-        # fused program would not reproduce, so they are fuse-ineligible.
+        # AUTO-layout keeps its own compiled artifacts (learned formats)
+        # that a re-trace inside a fused program would not reproduce, so
+        # it is fuse-ineligible. The ZeRO paths (stages 1-3) fuse: the
+        # carry is committed-sharded and FusedSequence keys the staged
+        # program on the placement ("sharded"/"stage" stay here for
+        # observers, not as a bail condition).
         run.fuse = {"step": step, "data_names": data_names,
                     "executor": self, "use_auto": use_auto,
                     "sharded": bool(sharded), "stage": stage}

@@ -685,14 +685,17 @@ class Module(BaseModule):
         ``cap.step_var`` is ``(params, states, aux, outs)``; its writeback
         keeps ``fs``/aux_dict/outputs in sync each iteration so a bail's
         replay closures resume from exactly the published state. The
-        AUTO-layout and ZeRO-1 paths own compiled artifacts (learned
-        formats, sharded placement) a plain re-trace would not reproduce,
-        so they stay on replay."""
+        AUTO-layout path owns compiled artifacts (learned formats) a
+        plain re-trace would not reproduce, so it stays on replay; the
+        ZeRO paths (MXNET_SHARDED_UPDATE stages 1-3) DO fuse — the carry
+        leaves are committed-sharded before staging and FusedSequence
+        folds their placement into the staged avals and fused_key, so
+        the one donated program lowers with the right shardings."""
         from .. import engine
         if not engine.fuse_enabled():
             return None, None
         meta = getattr(fs["step"], "fuse", None)
-        if meta is None or meta["use_auto"] or meta["sharded"]:
+        if meta is None or meta["use_auto"]:
             return None, None
         exec_ = meta["executor"]
         exec_group = self._exec_group
@@ -731,21 +734,27 @@ class Module(BaseModule):
         def step_feed(_lr=lr_arr, _wd=wd_arr):
             return (exec_._next_rng(), _lr, _wd)
 
+        # the step register leads with outs so the fused program's
+        # flattened output order (outs, params, states, aux) matches the
+        # unfused step's return order: with the carry donated, XLA pairs
+        # donated buffers to outputs in that order, and keeping the
+        # orders equal keeps the fused CPU-SPMD codegen (stages 2/3
+        # reduce-scatter placement) bitwise with the replay arm.
         def step_jax(data_reg, step_reg, rng, lr, wd):
-            params, states, aux, _outs = step_reg
+            _outs, params, states, aux = step_reg
             outs, new_p, new_s, aux_up = step_pure(params, states, aux,
                                                    rng, data_reg, lr, wd)
             na = dict(aux)
             na.update(aux_up)
-            return ((new_p, new_s, na, tuple(outs)),)
+            return ((tuple(outs), new_p, new_s, na),)
 
         def step_init():
-            return (fs["params"], fs["states"],
-                    {n: a._data for n, a in exec_.aux_dict.items()},
-                    tuple(o._data for o in exec_.outputs))
+            return (tuple(o._data for o in exec_.outputs),
+                    fs["params"], fs["states"],
+                    {n: a._data for n, a in exec_.aux_dict.items()})
 
         def step_writeback(d, _svar=svar):
-            new_p, new_s, na, outs = d[_svar]
+            outs, new_p, new_s, na = d[_svar]
             fs["params"], fs["states"] = new_p, new_s
             for n, v in na.items():
                 if n in exec_.aux_dict:

@@ -1,0 +1,161 @@
+"""NHWC layout islands for the conv backbone (MXNET_CONV_LAYOUT).
+
+The reference framework (and this repo's Symbol API) is NCHW/OIHW
+end-to-end. On TPU that is the wrong resident layout: the vector lanes
+are the LAST dimension (128 of them), so channels-last puts the channel
+axis on the lanes and lets XLA lower convolutions onto the MXU without
+relayouting around every conv. This module is the trace-time rewrite
+that runs the whole conv backbone in NHWC/HWIO while keeping the
+user-visible API, checkpoints, and per-channel quantization axes in the
+reference NCHW/OIHW layout:
+
+- **Islands, not per-op transposes.** A Convolution node seeds an
+  island: its input is transposed to NHWC once (the stem boundary) and
+  its output stays NHWC. Layout-agnostic neighbours — BatchNorm (the
+  impl is axis-general), Activation, Pooling, Dropout, elementwise
+  residual adds — PROPAGATE the tag instead of transposing, so the
+  entire ResNet/VGG backbone is one island with exactly two boundary
+  transposes (stem input, FC head), both of which XLA fuses into the
+  adjacent ops.
+- **Weights stay OIHW at rest.** The conv impl transposes OIHW -> HWIO
+  *inside* the traced program (a single transpose per weight per
+  program, hoisted/fused by XLA), so checkpoint save/load, the
+  initializer shapes, `quant.py` per-channel axes (axis 0 = O) and
+  `flops.py` MAC accounting are untouched.
+- **Gated.** `MXNET_CONV_LAYOUT=nhwc` (default) | `nchw` (the bitwise
+  reference arm — the pass is a no-op and every op sees exactly the
+  pre-rewrite NCHW program). Read at `Symbol.build_eval` time like
+  MXNET_BACKWARD_DO_MIRROR, so a rebind picks up a flip.
+
+The pass runs inside the traced evaluator (`symbol.build_eval`), so the
+transposes it inserts are ordinary jnp ops: autodiff produces the
+matching transposed cotangents and gradients land in the reference
+layout automatically. Values are tagged (a trace-time set of env keys),
+never wrapped — an op that the pass does not know is a *boundary*: its
+tagged inputs are transposed back to NCHW and its outputs are untagged,
+which is always correct, merely slower.
+"""
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+
+#: ops that are elementwise/broadcast-safe when every non-scalar input
+#: shares one shape: the tag propagates through them untouched. (A
+#: mixed-shape broadcast — e.g. a (1, C, 1, 1) operand — would change
+#: meaning under a transposed layout, so it falls to the boundary path.)
+_ELEMWISE = frozenset((
+    "Activation", "Dropout", "Cast", "clip", "relu", "sigmoid", "tanh",
+    "abs", "negative", "exp", "log", "sqrt", "square",
+    "_copy", "BlockGrad", "identity",
+    "_plus_scalar", "_minus_scalar", "_rminus_scalar", "_mul_scalar",
+    "_div_scalar", "_rdiv_scalar", "_maximum_scalar", "_minimum_scalar",
+    "_power_scalar",
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "broadcast_add", "broadcast_plus", "broadcast_sub", "broadcast_minus",
+    "broadcast_mul", "broadcast_div", "broadcast_maximum",
+    "broadcast_minimum",
+))
+
+_CONV_OPS = frozenset(("Convolution", "Convolution_v1"))
+_BN_OPS = frozenset(("BatchNorm", "BatchNorm_v1", "CuDNNBatchNorm"))
+_POOL_OPS = frozenset(("Pooling", "Pooling_v1"))
+
+_EMPTY = frozenset()
+_ALL0 = frozenset((0,))
+
+
+def conv_layout() -> str:
+    """The resident conv-backbone layout: ``nhwc`` (default) | ``nchw``."""
+    v = os.environ.get("MXNET_CONV_LAYOUT", "nhwc").lower()
+    return v if v in ("nhwc", "nchw") else "nhwc"
+
+
+def enabled() -> bool:
+    return conv_layout() == "nhwc"
+
+
+def to_nhwc(x):
+    return jnp.transpose(x, (0, 2, 3, 1))
+
+
+def to_nchw(x):
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+def _is4d(v):
+    return hasattr(v, "ndim") and v.ndim == 4
+
+
+def adapt(op_name, attrs, vals, in_tags):
+    """Trace-time layout adaptation for one graph node.
+
+    ``vals`` are the node's input values (args then aux) as traced
+    arrays; ``in_tags[i]`` is True when ``vals[i]`` is resident NHWC
+    (logical NCHW). Returns ``(attrs', vals', tagged_out)`` where
+    ``tagged_out`` is the frozenset of output indices that are resident
+    NHWC. ``attrs'`` is either the original dict or a copy — the node's
+    own attrs are never mutated.
+    """
+    vals = list(vals)
+
+    if op_name in _CONV_OPS:
+        kernel = attrs.get("kernel") or ()
+        if (len(tuple(kernel)) == 2 and _is4d(vals[0])
+                and attrs.get("layout") in (None, "NCHW")):
+            if not in_tags[0]:
+                vals[0] = to_nhwc(vals[0])  # island boundary: stem input
+            return dict(attrs, layout="NHWC"), vals, _ALL0
+        # 1-D/3-D or explicit-layout convs stay on the reference path
+        return _boundary(attrs, vals, in_tags)
+
+    if not any(in_tags):
+        # untouched region: nothing to transpose, nothing to tag
+        return attrs, vals, _EMPTY
+
+    if op_name in _BN_OPS:
+        data = vals[0]
+        if in_tags[0] and _is4d(data) and int(attrs.get("axis", 1)) % 4 == 1:
+            # the impl is axis-general; point it at channels-last. Only
+            # out[0] is spatial — mean/var (output_mean_var) and the
+            # moving-stat aux updates are per-channel 1-D either way.
+            return dict(attrs, axis=3), vals, _ALL0
+        return _boundary(attrs, vals, in_tags)
+
+    if op_name in _POOL_OPS:
+        if in_tags[0] and _is4d(vals[0]):
+            return dict(attrs, layout="NHWC"), vals, _ALL0
+        return _boundary(attrs, vals, in_tags)
+
+    if op_name == "LeakyReLU" and attrs.get("act_type", "leaky") != "prelu":
+        return attrs, vals, _ALL0 if in_tags[0] else _EMPTY
+
+    if op_name in _ELEMWISE:
+        # propagate when every non-scalar input shares the tagged shape
+        # (the ResNet residual add); transpose equal-shape untagged
+        # operands into the island instead of leaving it
+        ref = next(v.shape for v, t in zip(vals, in_tags) if t)
+        ok = True
+        for i, v in enumerate(vals):
+            if not hasattr(v, "ndim") or v.ndim == 0:
+                continue
+            if tuple(v.shape) != tuple(ref):
+                ok = False
+                break
+        if ok:
+            for i, (v, t) in enumerate(zip(vals, in_tags)):
+                if not t and _is4d(v):
+                    vals[i] = to_nhwc(v)
+            return attrs, vals, _ALL0
+        return _boundary(attrs, vals, in_tags)
+
+    return _boundary(attrs, vals, in_tags)
+
+
+def _boundary(attrs, vals, in_tags):
+    """Leave the island: tagged inputs return to NCHW, outputs untagged."""
+    for i, (v, t) in enumerate(zip(vals, in_tags)):
+        if t:
+            vals[i] = to_nchw(v)
+    return attrs, vals, _EMPTY

@@ -194,20 +194,55 @@ def _stem_conv_s2d(data, weight, bias):
     return out
 
 
+def _stem_conv_s2d_nhwc(data, weight, bias):
+    """NHWC-resident twin of :func:`_stem_conv_s2d` (same blocked-channel
+    index ``(c*2 + hp)*2 + wp``, so the blocked OIHW weight construction
+    is shared and only transposed to HWIO at the end)."""
+    N, H, W, C = data.shape
+    K = weight.shape[0]
+    xp = jnp.pad(data, ((0, 0), (3, 3), (3, 3), (0, 0)))
+    hp, wp_ = (H + 6) // 2, (W + 6) // 2
+    xs = xp.reshape(N, hp, 2, wp_, 2, C)
+    xs = xs.transpose(0, 1, 3, 5, 2, 4).reshape(N, hp, wp_, C * 4)
+    wpad = jnp.pad(weight, ((0, 0), (0, 0), (0, 1), (0, 1)))  # 7 -> 8 taps
+    ws = wpad.reshape(K, C, 4, 2, 4, 2)
+    ws = ws.transpose(0, 1, 3, 5, 2, 4).reshape(K, C * 4, 4, 4)
+    ws = ws.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+    dn = jax.lax.conv_dimension_numbers(xs.shape, ws.shape,
+                                        ("NHWC", "HWIO", "NHWC"))
+    out = jax.lax.conv_general_dilated(
+        xs, ws, window_strides=(1, 1), padding="VALID", dimension_numbers=dn)
+    if bias is not None:
+        out = out + bias.reshape((1, 1, 1, -1))
+    return out
+
+
 def _conv_forward(attrs, data, weight, bias):
     kernel = tuple(attrs["kernel"])
     n = len(kernel)
     stride = _ntuple(attrs["stride"], n)
     dilate = _ntuple(attrs["dilate"], n)
     pad = _ntuple(attrs["pad"], n) if attrs["pad"] else (0,) * n
+    nhwc = attrs.get("layout") == "NHWC"  # layout-island pass (ops/layout.py)
+    c_axis = 3 if nhwc else 1
+    sp0 = 1 if nhwc else 2
     if (kernel == (7, 7) and stride == (2, 2) and pad == (3, 3)
             and dilate == (1, 1) and int(attrs["num_group"]) == 1
-            and data.ndim == 4 and data.shape[1] <= 4
+            and data.ndim == 4 and data.shape[c_axis] <= 4
             and data.shape[0] >= 128  # measured: wins at large batch only
-            and data.shape[2] % 2 == 0 and data.shape[3] % 2 == 0
+            and data.shape[sp0] % 2 == 0 and data.shape[sp0 + 1] % 2 == 0
             and os.environ.get("MXNET_CONV_S2D", "1") != "0"):
-        return _stem_conv_s2d(data, weight, bias)
-    dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dnums(n))
+        return (_stem_conv_s2d_nhwc if nhwc else _stem_conv_s2d)(
+            data, weight, bias)
+    if nhwc:
+        # weight stays OIHW at rest (checkpoint/quant/flops parity); the
+        # in-program transpose to HWIO is hoisted/fused by XLA and costs
+        # one relayout per program, not per step-region
+        weight = jnp.transpose(weight, (2, 3, 1, 0))
+        dims = ("NHWC", "HWIO", "NHWC")
+    else:
+        dims = _conv_dnums(n)
+    dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, dims)
     out = jax.lax.conv_general_dilated(
         data,
         weight,
@@ -219,7 +254,8 @@ def _conv_forward(attrs, data, weight, bias):
         preferred_element_type=None,
     )
     if bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * n)
+        out = out + (bias.reshape((1, 1, 1, -1)) if nhwc
+                     else bias.reshape((1, -1) + (1,) * n))
     return out
 
 
@@ -234,7 +270,10 @@ _CONV_PARAM_DOCS = {
     "no_bias": "Whether to disable the bias term.",
     "cudnn_tune": "Accepted for API parity (off|limited_workspace|fastest); algorithm choice is the compiler's.",
     "cudnn_off": "Accepted for API parity; there is no cuDNN on TPU.",
-    "layout": "Data layout (NCHW/NCDHW); None means the default NC+spatial.",
+    "layout": "Data layout (NCHW/NCDHW); None means the default NC+spatial. "
+              "NHWC is set internally by the layout-island pass "
+              "(ops/layout.py, MXNET_CONV_LAYOUT) — data channels-last, "
+              "weight still OIHW at the API boundary.",
 }
 
 
@@ -355,10 +394,14 @@ def _deconvolution(attrs, data, weight, bias=None):
 )
 def _pooling(attrs, data):
     """max/avg/sum pooling via lax.reduce_window (reference pooling-inl.h,
-    src/operator/nn/pool.h). 'full' convention = ceil output sizing."""
+    src/operator/nn/pool.h). 'full' convention = ceil output sizing.
+    ``layout=NHWC`` (set only by the layout-island pass, ops/layout.py)
+    runs the same window channels-last."""
+    nhwc = attrs.get("layout") == "NHWC"
     nsp = data.ndim - 2
+    sp0 = 1 if nhwc else 2  # first spatial axis
     if attrs["global_pool"]:
-        axes = tuple(range(2, data.ndim))
+        axes = tuple(range(sp0, sp0 + nsp))
         if attrs["pool_type"] == "max":
             out = jnp.max(data, axis=axes, keepdims=True)
         elif attrs["pool_type"] == "sum":
@@ -373,14 +416,19 @@ def _pooling(attrs, data):
     for i in range(nsp):
         lo = hi = pad[i]
         if attrs["pooling_convention"] == "full":
-            size = data.shape[2 + i] + 2 * pad[i] - kernel[i]
+            size = data.shape[sp0 + i] + 2 * pad[i] - kernel[i]
             out_i = -(-size // stride[i]) + 1  # ceil
-            need = (out_i - 1) * stride[i] + kernel[i] - (data.shape[2 + i] + 2 * pad[i])
+            need = (out_i - 1) * stride[i] + kernel[i] - (data.shape[sp0 + i] + 2 * pad[i])
             hi += max(0, need)
         pads.append((lo, hi))
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    padcfg = [(0, 0), (0, 0)] + pads
+    if nhwc:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        padcfg = [(0, 0)] + pads + [(0, 0)]
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        padcfg = [(0, 0), (0, 0)] + pads
     ptype = attrs["pool_type"]
     if ptype == "max":
         # init must be a CONCRETE scalar (np, not jnp): reduce_window's

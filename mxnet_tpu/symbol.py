@@ -515,7 +515,16 @@ class Symbol:
         note_memory.md "memonger" memory-for-FLOPs trade
         (graph_executor.cc:213-226), realized the TPU way. ``None`` reads
         the MXNET_BACKWARD_DO_MIRROR env var (1 = auto ≈ sqrt(#ops),
-        k>1 = exactly k segments)."""
+        k>1 = exactly k segments).
+
+        MXNET_CONV_LAYOUT=nhwc (default; read here, like the mirror
+        flag) additionally runs the conv backbone as NHWC layout islands
+        (ops/layout.py): convs seed islands, layout-agnostic neighbours
+        propagate them, anything else transposes back — so the rewrite
+        is local to this evaluator and the graph/API stay NCHW."""
+        from .ops import layout as _oplayout
+
+        nhwc = _oplayout.enabled()
         nodes = self._nodes()
         entries = self._entries
         if remat_segments is None:
@@ -534,6 +543,7 @@ class Symbol:
 
         def eval_fn(arg_values, aux_values, is_train, rng):
             env: Dict[Tuple[int, int], Any] = {}
+            tags = set()  # env keys whose value is resident NHWC
             aux_updates: Dict[str, Any] = {}
             for ni, node in enumerate(nodes):
                 if node.is_var:
@@ -547,6 +557,11 @@ class Symbol:
                 vals = [env[(id(c), i)] for c, i in node.inputs]
                 n_aux = len(op.get_aux_names(attrs)) if not op.variadic else 0
                 n_args = len(vals) - n_aux
+                tagged_out = ()
+                if nhwc:
+                    attrs, vals, tagged_out = _oplayout.adapt(
+                        op.name, attrs, vals,
+                        [(id(c), i) in tags for c, i in node.inputs])
                 node_rng = None
                 if op.needs_rng:
                     node_rng = jax.random.fold_in(rng, ni)
@@ -556,20 +571,36 @@ class Symbol:
                 )
                 for i, o in enumerate(outs):
                     env[(id(node), i)] = o
+                    if i in tagged_out:
+                        tags.add((id(node), i))
                 for (child, _), new in zip(node.inputs[n_args:], aux_out):
                     if child.is_var:
                         aux_updates[child.name] = new
-            outputs = [env[(id(n), i)] for n, i in entries]
+            outputs = [(_oplayout.to_nchw(env[(id(n), i)])
+                        if (id(n), i) in tags else env[(id(n), i)])
+                       for n, i in entries]
             return outputs, aux_updates
 
         return eval_fn
 
     def _build_eval_segmented(self, nodes, entries, n_segments):
         """Segmented evaluator: contiguous topo chunks, each under
-        jax.checkpoint; only chunk-boundary values are saved for backward."""
+        jax.checkpoint; only chunk-boundary values are saved for backward.
+
+        NHWC layout islands (MXNET_CONV_LAYOUT, ops/layout.py) span
+        chunk boundaries: the tag set lives in the evaluator scope, so a
+        value that leaves one chunk resident-NHWC enters the next one
+        tagged — the per-conv layouts (and therefore the numerics) match
+        the unsegmented evaluator exactly, and jax.checkpoint simply
+        stores the NHWC boundary value. The retrace during backward
+        re-derives the same tags (the pass is deterministic)."""
         import math
 
         import builtins
+
+        from .ops import layout as _oplayout
+
+        nhwc = _oplayout.enabled()
 
         op_nodes = [(ni, n) for ni, n in enumerate(nodes) if not n.is_var]
         # `min`/`max`/`sum` are generated op functions in this namespace
@@ -602,6 +633,7 @@ class Symbol:
 
         def eval_fn(arg_values, aux_values, is_train, rng):
             env: Dict[Tuple[int, int], Any] = {}
+            tags = set()  # NHWC-resident keys, shared across chunks
             aux_updates: Dict[str, Any] = {}
             for node in nodes:
                 if node.is_var:
@@ -623,6 +655,11 @@ class Symbol:
                         n_aux = (len(op.get_aux_names(attrs))
                                  if not op.variadic else 0)
                         n_args = len(vals) - n_aux
+                        tagged_out = ()
+                        if nhwc:
+                            attrs, vals, tagged_out = _oplayout.adapt(
+                                op.name, attrs, vals,
+                                [(id(c), i) in tags for c, i in node.inputs])
                         node_rng = (jax.random.fold_in(c_rng, ni)
                                     if op.needs_rng else None)
                         outs, aux_out = op.impl(
@@ -630,6 +667,8 @@ class Symbol:
                             OpContext(is_train, node_rng))
                         for i, o in enumerate(outs):
                             local[(id(node), i)] = o
+                            if i in tagged_out:
+                                tags.add((id(node), i))
                         for (child, _), new in zip(node.inputs[n_args:],
                                                    aux_out):
                             if child.is_var:

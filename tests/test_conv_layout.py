@@ -1,0 +1,204 @@
+"""NHWC layout islands (MXNET_CONV_LAYOUT; ops/layout.py, ISSUE 20).
+
+The conv backbone runs resident-NHWC/HWIO on the default path while the
+user-visible API, checkpoints, and gradients stay NCHW/OIHW. These tests
+pin the contract:
+
+- forward parity NHWC vs the bitwise-reference NCHW arm at tight
+  tolerance across resnet, vgg, and a grouped conv;
+- grad parity at the f32 cross-layout tolerance (conv-backward reduction
+  reassociation differs between layouts; the few noisy elements are
+  near-zero-magnitude summation-order noise, not layout bugs);
+- the island rule actually fires: every conv in the lowered NHWC
+  program is channels-last, and the transpose count stays at the
+  island-boundary + per-weight budget (no per-layer relayouting);
+- the space-to-depth stem twin matches the NCHW stem;
+- an 8-step Module train run ends with weights matching across layouts.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+# f32 conv-backward reassociation across layouts: forward is tight,
+# grads carry summation-order noise on near-zero elements in deep nets
+FWD = dict(rtol=1e-5, atol=1e-6)
+GRAD = dict(rtol=5e-3, atol=5e-3)
+
+
+def _setup(sym, shapes):
+    import jax
+    import jax.numpy as jnp
+
+    arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
+    rng = np.random.RandomState(0)
+    args = {n: jnp.asarray(rng.uniform(-0.1, 0.1, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), arg_shapes)}
+    auxs = {n: (jnp.ones(s, jnp.float32) if "var" in n
+                else jnp.zeros(s, jnp.float32))
+            for n, s in zip(sym.list_auxiliary_states(), aux_shapes)}
+    return args, auxs, jax.random.PRNGKey(0)
+
+
+def _both_layouts(monkeypatch, sym, shapes, train=True):
+    """(outs, auxs, grads) under NCHW then NHWC for one symbol."""
+    import jax
+    import jax.numpy as jnp
+
+    args, auxs, key = _setup(sym, shapes)
+    res = {}
+    for layout in ("nchw", "nhwc"):
+        monkeypatch.setenv("MXNET_CONV_LAYOUT", layout)
+        f = sym.build_eval()
+
+        def loss(a):
+            o, aux = f(a, auxs, train, key)
+            return sum(jnp.sum(x * x) for x in o), (o, aux)
+
+        # one evaluation serves outs, aux, and grads (these deep-net
+        # eager evals dominate the file's runtime)
+        (_, (outs, aux_out)), grads = \
+            jax.value_and_grad(loss, has_aux=True)(args)
+        res[layout] = (outs, aux_out, grads)
+    return res
+
+
+def _assert_parity(res):
+    o1, a1, g1 = res["nchw"]
+    o2, a2, g2 = res["nhwc"]
+    for x, y in zip(o1, o2):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), **FWD)
+    for k in a1:
+        np.testing.assert_allclose(np.asarray(a1[k]), np.asarray(a2[k]),
+                                   **FWD, err_msg=k)
+    for k in g1:
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]),
+                                   **GRAD, err_msg=k)
+
+
+def test_resnet_fwd_grad_parity(monkeypatch):
+    sym = models.get_symbol("resnet-18", num_classes=10)
+    _assert_parity(_both_layouts(
+        monkeypatch, sym, dict(data=(2, 3, 32, 32), softmax_label=(2,))))
+
+
+def test_vgg_fwd_grad_parity(monkeypatch):
+    sym = models.get_symbol("vgg", num_classes=10, num_layers=11)
+    _assert_parity(_both_layouts(
+        monkeypatch, sym, dict(data=(2, 3, 32, 32), softmax_label=(2,))))
+
+
+def test_grouped_conv_parity(monkeypatch):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                             num_group=4, pad=(1, 1), name="gconv")
+    net = mx.sym.BatchNorm(net, name="bn")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    sym = mx.sym.Flatten(net)
+    _assert_parity(_both_layouts(monkeypatch, sym,
+                                 dict(data=(2, 8, 8, 8))))
+
+
+def test_nhwc_program_is_channels_last(monkeypatch):
+    """The island rule delivers: every convolution in the lowered NHWC
+    program is channels-last ([b, 0, 1, f]), none channels-first, and
+    the transpose count stays within the per-weight + island-boundary
+    budget (no per-layer data relayouting)."""
+    import jax
+    import jax.numpy as jnp
+
+    sym = models.get_symbol("resnet-18", num_classes=10)
+    args, auxs, key = _setup(sym, dict(data=(2, 3, 32, 32),
+                                       softmax_label=(2,)))
+
+    def lowered(layout):
+        monkeypatch.setenv("MXNET_CONV_LAYOUT", layout)
+        f = sym.build_eval()
+        return jax.jit(lambda a: f(a, auxs, False, key)).lower(args) \
+            .as_text()
+
+    t = lowered("nhwc")
+    n_conv = t.count("stablehlo.convolution")
+    assert n_conv > 0
+    assert t.count("[b, 0, 1, f]") == 2 * n_conv  # lhs+out channels-last
+    assert "[b, f, 0, 1]" not in t                # no NCHW convs remain
+    # budget: one weight transpose per conv + a handful of island
+    # boundaries (stem input, head), never per-layer relayouts
+    assert t.count("stablehlo.transpose") <= n_conv + 6
+    t0 = lowered("nchw")
+    assert t0.count("[b, f, 0, 1]") == 2 * t0.count("stablehlo.convolution")
+
+
+def test_s2d_stem_nhwc_matches_nchw(monkeypatch):
+    """The space-to-depth stem (MXNET_CONV_S2D) has an NHWC twin; both
+    arms and the plain 7x7/2 conv agree."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import nn as opsnn
+
+    rng = np.random.RandomState(0)
+    data = jnp.asarray(rng.uniform(-1, 1, (128, 3, 16, 16))
+                       .astype(np.float32))
+    weight = jnp.asarray(rng.uniform(-0.1, 0.1, (8, 3, 7, 7))
+                         .astype(np.float32))
+    bias = jnp.asarray(rng.uniform(-0.1, 0.1, (8,)).astype(np.float32))
+    attrs = dict(kernel=(7, 7), stride=(2, 2), pad=(3, 3), dilate=(1, 1),
+                 num_filter=8, num_group=1, no_bias=False)
+
+    monkeypatch.setenv("MXNET_CONV_S2D", "0")
+    ref = opsnn._conv_forward(attrs, data, weight, bias)
+    monkeypatch.setenv("MXNET_CONV_S2D", "1")
+    nchw = opsnn._conv_forward(attrs, data, weight, bias)
+    from mxnet_tpu.ops import layout as oplayout
+    nhwc = oplayout.to_nchw(opsnn._conv_forward(
+        dict(attrs, layout="NHWC"), oplayout.to_nhwc(data), weight, bias))
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(nchw),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(nchw), np.asarray(nhwc),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_module_train_parity(monkeypatch):
+    """8 identically-seeded Module train steps end with matching weights
+    across the two layouts (the shallow CNN keeps cross-layout f32
+    noise inside a much tighter band than the deep-net grad bound)."""
+    from mxnet_tpu.initializer import Uniform
+
+    def train(layout):
+        monkeypatch.setenv("MXNET_CONV_LAYOUT", layout)
+        data = mx.sym.Variable("data")
+        net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                                 pad=(1, 1), name="conv1")
+        net = mx.sym.BatchNorm(net, name="bn1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                             pool_type="max")
+        net = mx.sym.Flatten(net)
+        net = mx.sym.FullyConnected(net, num_hidden=4, name="fc")
+        sym = mx.sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(sym)
+        mx.random.seed(11)
+        mod.bind(data_shapes=[("data", (8, 3, 12, 12))],
+                 label_shapes=[("softmax_label", (8,))])
+        mod.init_params(Uniform(0.1))
+        mod.init_optimizer(kvstore=None, optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05,
+                                             "momentum": 0.9})
+        r = np.random.RandomState(5)
+        for _ in range(8):
+            b = mx.io.DataBatch(
+                data=[mx.nd.array(r.uniform(-1, 1, (8, 3, 12, 12))
+                                  .astype(np.float32))],
+                label=[mx.nd.array(r.randint(0, 4, (8,))
+                                   .astype(np.float32))])
+            mod.fit_step(b)
+        return {n: a.asnumpy().copy()
+                for n, a in mod.get_params()[0].items()}
+
+    w1, w2 = train("nchw"), train("nhwc")
+    for n in w1:
+        np.testing.assert_allclose(w1[n], w2[n], rtol=2e-4, atol=2e-4,
+                                   err_msg=n)

@@ -288,3 +288,71 @@ def test_serving_fused_dispatch_matches_eager():
     assert cs.fused_runs > 0 and cs.fuse_bails == 0
     for a, b in zip(o_eager, o_fused):
         assert np.array_equal(a, b)
+
+
+@pytest.mark.parallel
+@pytest.mark.parametrize("stage", [2, 3])
+def test_fit_step_fused_sharded_bitwise_equals_replay(monkeypatch, stage):
+    """ZeRO stages 2/3 (MXNET_SHARDED_UPDATE) stage into the one donated
+    fused program — the committed carry placement rides the staged avals
+    (engine._sharding_sig) instead of forcing a bail — and 8 steps of
+    fused weights are BITWISE equal to the replay arm's."""
+    import jax
+
+    monkeypatch.setenv("MXNET_SHARDED_UPDATE", str(stage))
+    in_dim, steps, dp = 8, 8, 4
+
+    def build():
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+        sym = mx.sym.SoftmaxOutput(net, name="softmax")
+        ctxs = [mx.Context("cpu", i) for i in range(dp)]
+        mod = mx.mod.Module(sym, context=ctxs)
+        mx.random.seed(7)
+        mod.bind(data_shapes=[("data", (16, in_dim))],
+                 label_shapes=[("softmax_label", (16,))])
+        from mxnet_tpu.initializer import Uniform
+        mod.init_params(Uniform(0.1))
+        mod.init_optimizer(
+            kvstore=None, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+        return mod
+
+    def batches():
+        r = np.random.RandomState(4)
+        return [mx.io.DataBatch(
+            data=[mx.nd.array(r.uniform(-1, 1, (16, in_dim))
+                              .astype(np.float32))],
+            label=[mx.nd.array(r.randint(0, 4, (16,)).astype(np.float32))])
+            for _ in range(steps)]
+
+    monkeypatch.setenv("MXNET_ENGINE_CAPTURE", "1")
+    monkeypatch.delenv("MXNET_ENGINE_FUSE", raising=False)
+    mod_r = build()
+    for bt in batches():
+        mod_r.fit_step(bt)
+    seq_r = mod_r._fused_fit["capture"].seq
+    assert seq_r.replays > 0 and seq_r.fused_runs == 0
+    w_replay = {n: arr.asnumpy().copy()
+                for n, arr in mod_r.get_params()[0].items()}
+
+    monkeypatch.setenv("MXNET_ENGINE_FUSE", "1")
+    mod_f = build()
+    for bt in batches():
+        mod_f.fit_step(bt)
+    seq = mod_f._fused_fit["capture"].seq
+    assert seq._fuse_state == "staged"
+    assert seq.fused_runs > 0 and seq.fuse_bails == 0
+    assert engine.fused_stats()["runs"] > 0
+    # the sharded placement is folded into the staged signature: the
+    # carry avals carry a NamedSharding leg, not None
+    sh = mod_f._fused_fit["params"]["fc1_weight"].sharding
+    assert engine._sharding_sig(
+        mod_f._fused_fit["params"]["fc1_weight"]) is not None
+    assert isinstance(sh, jax.sharding.NamedSharding)
+    w_fused = {n: arr.asnumpy().copy()
+               for n, arr in mod_f.get_params()[0].items()}
+    for n in w_replay:
+        assert np.array_equal(w_replay[n], w_fused[n]), n

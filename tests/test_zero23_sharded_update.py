@@ -19,8 +19,10 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8). Covers:
   ``train_param_bytes``/``train_grad_bytes{stage=}`` gauges, plus the
   gauges and the ``train.allgather_prefetch`` span themselves;
 - capture/fuse composition: stages 2/3 under MXNET_ENGINE_CAPTURE
-  match eager bitwise, and MXNET_ENGINE_FUSE cleanly bails to replay
-  (the sharded step owns compiled placement a re-trace would lose);
+  match eager bitwise, and MXNET_ENGINE_FUSE now stages the sharded
+  step into the ONE donated fused program (the committed carry
+  placement rides the staged avals; ISSUE 20) — fused weights stay
+  bitwise with the replay arm;
 - ZeRO-3 checkpoints: local-write snapshot (no device re-replication)
   bitwise-equal to the synced exec values, dp=4 -> 2 -> 4 resharding
   round-trip bitwise INCLUDING momentum state, restore resumes
@@ -291,10 +293,11 @@ def test_stage3_gauges_and_prefetch_span(monkeypatch):
 # --- capture / fuse composition ---------------------------------------------
 
 @pytest.mark.parametrize("stage", [2, 3])
-def test_stage_capture_fuse_bails_to_replay_bitwise(monkeypatch, stage):
-    """MXNET_ENGINE_CAPTURE at stages 2/3 replays bitwise-equal to the
-    uncaptured run; MXNET_ENGINE_FUSE cleanly declines (meta['sharded'])
-    — the sequence stays on replay, never a wrong fused program."""
+def test_stage_capture_fuse_runs_fused_bitwise(monkeypatch, stage):
+    """MXNET_ENGINE_FUSE at stages 2/3 stages the sharded step into the
+    one donated fused program (no bail: the committed carry placement is
+    part of the staged avals) and the fused weights are BITWISE equal to
+    the uncaptured run."""
     monkeypatch.delenv("MXNET_ENGINE_CAPTURE", raising=False)
     monkeypatch.delenv("MXNET_ENGINE_FUSE", raising=False)
     eager = _train_mlp(monkeypatch, stage)
@@ -307,8 +310,9 @@ def test_stage_capture_fuse_bails_to_replay_bitwise(monkeypatch, stage):
     cap = mod._fused_fit.get("capture")
     assert cap is not None
     seq = cap.seq
-    assert seq.fused_runs == 0          # the documented clean bail
-    assert seq.replays > 0
+    assert seq._fuse_state == "staged"
+    assert seq.fused_runs > 0
+    assert seq.fuse_bails == 0
     w_cap = {n: a.asnumpy().copy() for n, a in mod.get_params()[0].items()}
     for n in w_eager:
         assert np.array_equal(w_eager[n], w_cap[n]), n

@@ -10,7 +10,14 @@ analysis and the selection table for the Pallas weight-grad kernel
 (ops/pallas/conv_bwd.py): the fast path is only wired where this table
 says XLA leaves throughput on the floor.
 
-    python tools/bench_conv_bwd.py [--batch 128] [--json]
+    python tools/bench_conv_bwd.py [--batch 128] [--json] \\
+        [--layout nchw|nhwc]
+
+--layout nhwc times the same contractions on the NHWC/HWIO resident
+layout the executor's default island path (MXNET_CONV_LAYOUT=nhwc,
+ops/layout.py) actually runs, with no boundary transposes in the loop —
+including the Pallas wgrad candidate, which is NHWC-native and stops
+paying its relayout tax on this arm.
 """
 import argparse
 import json
@@ -54,6 +61,9 @@ def main():
     p.add_argument("--json", action="store_true")
     p.add_argument("--only", help="substring filter on shape name")
     p.add_argument("--no-pallas", action="store_true")
+    p.add_argument("--layout", choices=["nchw", "nhwc"], default="nchw",
+                   help="resident layout to time (default nchw reference;"
+                        " nhwc = the MXNET_CONV_LAYOUT=nhwc island path)")
     args = p.parse_args()
 
     import numpy as np
@@ -76,8 +86,16 @@ def main():
         dy = jnp.asarray(np.random.RandomState(2)
                          .randn(N, K, OH, OH).astype(np.float32),
                          dtype=jnp.bfloat16)
-        dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
-                                            ("NCHW", "OIHW", "NCHW"))
+        if args.layout == "nhwc":
+            # same logical tensors, resident channels-last/HWIO — the
+            # layout the executor's island path keeps them in
+            x = jnp.transpose(x, (0, 2, 3, 1))
+            w = jnp.transpose(w, (2, 3, 1, 0))
+            dy = jnp.transpose(dy, (0, 2, 3, 1))
+            dims = ("NHWC", "HWIO", "NHWC")
+        else:
+            dims = ("NCHW", "OIHW", "NCHW")
+        dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, dims)
 
         def conv(x, w):
             return jax.lax.conv_general_dilated(
@@ -124,11 +142,16 @@ def main():
             return jax.jit(run)
 
         def pallas_wgrad(x_, w_, dy_):
-            # same contraction through the Pallas kernel (NHWC inside;
-            # boundary transposes included in its cost, as the real fast
-            # path would pay them)
+            # same contraction through the Pallas kernel (NHWC-native).
+            # On the nchw arm the boundary transposes are part of its
+            # cost, as a fast path grafted under the reference layout
+            # would pay them; on the nhwc arm the operands are already
+            # resident channels-last and no transpose is timed.
             from mxnet_tpu.ops.pallas.conv_bwd import conv_wgrad
 
+            if args.layout == "nhwc":
+                dw = conv_wgrad(x_, dy_, ksz, stride, pad)
+                return dw.astype(w_.dtype)  # (kh,kw,C,K) == resident HWIO
             xh = jnp.transpose(x_, (0, 2, 3, 1))
             dyh = jnp.transpose(dy_, (0, 2, 3, 1))
             dw = conv_wgrad(xh, dyh, ksz, stride, pad)  # (kh,kw,C,K) f32
@@ -171,7 +194,8 @@ def main():
             except Exception as e:
                 print("  pallas wgrad failed for %s: %s" % (name, e))
         flops = 2.0 * N * OH * OH * C * K * ksz * ksz
-        row = dict(name=name, C=C, HW=HW, K=K, k=ksz, s=stride, count=count,
+        row = dict(name=name, layout=args.layout,
+                   C=C, HW=HW, K=K, k=ksz, s=stride, count=count,
                    fwd_ms=round(t_f * 1e3, 3), fwd_tf=round(flops / t_f / 1e12, 1),
                    dgrad_ms=round(t_d * 1e3, 3), dgrad_tf=round(flops / t_d / 1e12, 1),
                    wgrad_ms=round(t_w * 1e3, 3), wgrad_tf=round(flops / t_w / 1e12, 1))
@@ -201,9 +225,10 @@ def main():
         tot["wgrad"] += r["wgrad_ms"] * r["count"]
         fl += 2.0 * N * (r["HW"] // r["s"]) ** 2 * r["C"] * r["K"] * r["k"] ** 2 \
             * r["count"]
-    print("totals (weighted by count): fwd %.1f ms, dgrad %.1f ms, "
+    print("totals (%s, weighted by count): fwd %.1f ms, dgrad %.1f ms, "
           "wgrad %.1f ms; conv FLOPs/step %.2f TF"
-          % (tot["fwd"], tot["dgrad"], tot["wgrad"], fl / 1e12))
+          % (args.layout, tot["fwd"], tot["dgrad"], tot["wgrad"],
+             fl / 1e12))
 
 
 if __name__ == "__main__":
